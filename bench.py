@@ -1,0 +1,82 @@
+"""Benchmark: samples/sec/chip for MultiLayerNetwork.fit-equivalent training.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+BASELINE config 1: MNIST 3-layer MLP (BASELINE.md — the reference publishes no
+numbers; vs_baseline compares to the last value recorded in BENCH_HISTORY.json
+when present, else 1.0).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.datasets.mnist import synthetic_mnist
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    batch_size = 4096
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.05).n_in(784).activation_function("relu")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1)
+            .batch_size(batch_size)
+            .compute_dtype("bfloat16")
+            .list(3)
+            .hidden_layer_sizes([2048, 1024])
+            .override(2, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=10)
+            .pretrain(False)
+            .build())
+    net = MultiLayerNetwork(conf)
+
+    x_np, y_np = synthetic_mnist(batch_size)
+    x, y = jnp.asarray(x_np), jnp.asarray(y_np)
+
+    # Warm up (compile)
+    net.fit(x, y)
+    jax.block_until_ready(net.params())
+
+    steps = 50
+    start = time.perf_counter()
+    for _ in range(steps):
+        net.fit(x, y)
+    jax.block_until_ready(net.params())
+    elapsed = time.perf_counter() - start
+
+    samples_per_sec = steps * batch_size / elapsed
+    n_chips = max(1, len(jax.devices()))
+    value = samples_per_sec / n_chips
+
+    hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_HISTORY.json")
+    vs_baseline = 1.0
+    try:
+        with open(hist_path) as f:
+            hist = json.load(f)
+        if hist.get("value"):
+            vs_baseline = value / hist["value"]
+    except (OSError, ValueError):
+        hist = None
+    try:
+        with open(hist_path, "w") as f:
+            json.dump({"value": value, "ts": time.time()}, f)
+    except OSError:
+        pass
+
+    print(json.dumps({
+        "metric": "mlp_mnist_train_samples_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

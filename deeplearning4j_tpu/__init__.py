@@ -1,0 +1,18 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+Capability parity with early Deeplearning4j (reference surveyed in SURVEY.md):
+layer-based NN core, pluggable batch optimizers, JSON-serializable configuration,
+dataset pipeline, evaluation, t-SNE, NLP stack, and data-parallel distributed
+training — rebuilt idiomatically for TPU: JAX/XLA autodiff in place of
+hand-written backprop, `jax.sharding.Mesh` + collectives in place of
+Akka/Hazelcast/Spark parameter averaging, and a native (C++) host runtime for IO.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.config import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401
+from deeplearning4j_tpu.eval.evaluation import Evaluation  # noqa: F401

@@ -1,0 +1,6 @@
+from deeplearning4j_tpu.config.neural_net_configuration import (  # noqa: F401
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.config.multi_layer_configuration import (  # noqa: F401
+    MultiLayerConfiguration,
+)

@@ -1,0 +1,11 @@
+from deeplearning4j_tpu.datasets.api import (  # noqa: F401
+    DataSet,
+    DataSetIterator,
+    ListDataSetIterator,
+    SamplingDataSetIterator,
+    MultipleEpochsIterator,
+    TestDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.csv import CSVDataSetIterator  # noqa: F401

@@ -1,0 +1,2 @@
+from deeplearning4j_tpu.eval.evaluation import Evaluation  # noqa: F401
+from deeplearning4j_tpu.eval.confusion import ConfusionMatrix  # noqa: F401

@@ -1,0 +1,35 @@
+"""Confusion matrix (reference core/eval/ConfusionMatrix.java, 258 LoC)."""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List
+
+
+class ConfusionMatrix:
+    def __init__(self, classes: List[int]):
+        self.classes = sorted(classes)
+        self.matrix: Dict[int, Dict[int, int]] = defaultdict(lambda: defaultdict(int))
+
+    def add(self, actual: int, predicted: int, count: int = 1) -> None:
+        self.matrix[actual][predicted] += count
+
+    def count(self, actual: int, predicted: int) -> int:
+        return self.matrix[actual][predicted]
+
+    def actual_total(self, actual: int) -> int:
+        return sum(self.matrix[actual].values())
+
+    def predicted_total(self, predicted: int) -> int:
+        return sum(row[predicted] for row in self.matrix.values())
+
+    def total(self) -> int:
+        return sum(self.actual_total(c) for c in self.classes)
+
+    def __str__(self) -> str:
+        header = "actual\\pred " + " ".join(f"{c:>6}" for c in self.classes)
+        rows = [header]
+        for a in self.classes:
+            rows.append(f"{a:>11} " + " ".join(
+                f"{self.count(a, p):>6}" for p in self.classes))
+        return "\n".join(rows)

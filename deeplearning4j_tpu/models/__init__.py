@@ -1,0 +1,2 @@
+"""Model families (RBM, autoencoders, LSTM, convolution) — importing this
+package registers their layer types in the layer registry."""

@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.nn import preprocessors  # noqa: F401  (registers)
+from deeplearning4j_tpu.nn.layers import LAYER_REGISTRY, make_layer  # noqa: F401
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401

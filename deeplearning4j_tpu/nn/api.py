@@ -1,0 +1,64 @@
+"""Core model/layer interfaces.
+
+Parity: reference core/nn/api/Model.java:34-193 (fit/score/params/gradient/
+paramTable) and Layer.java:33-94 (activate/preOutput/merge/transpose). The
+TPU-native contract is functional: a Layer object is a stateless definition
+bound to its NeuralNetConfiguration; parameters live in pytrees threaded
+through pure `apply` functions so jit/grad/vmap/shard_map compose. The
+stateful DL4J-style surface (fit/params/setParams) is layered on top in
+MultiLayerNetwork.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Protocol, runtime_checkable
+
+import jax
+
+Params = Dict[str, Any]  # named-parameter table, e.g. {"W": ..., "b": ...}
+
+
+@runtime_checkable
+class Layer(Protocol):
+    """A layer definition. Stateless; parameters are explicit pytrees."""
+
+    conf: Any
+
+    def init_params(self, key: jax.Array) -> Params:
+        """Create this layer's named-parameter table (ParamInitializer parity:
+        reference core/nn/params/DefaultParamInitializer.java:29-50)."""
+        ...
+
+    def pre_output(self, params: Params, x, **kw):
+        """Affine/pre-activation output (reference BaseLayer.preOutput :176)."""
+        ...
+
+    def activate(self, params: Params, x, *, rng: Optional[jax.Array] = None,
+                 training: bool = False):
+        """Forward activation (reference BaseLayer.activate :202)."""
+        ...
+
+
+class PretrainLayer(Layer, Protocol):
+    """A layer trainable unsupervised (RBM / AutoEncoder family).
+
+    Parity: reference core/nn/layers/BasePretrainNetwork.java — exposes an
+    unsupervised loss over (params, batch, rng) that layer-wise pretraining
+    minimizes, plus a reconstruction transform.
+    """
+
+    def pretrain_loss(self, params: Params, x, rng: jax.Array):
+        ...
+
+    def reconstruct(self, params: Params, x):
+        ...
+
+
+def merge_params(a: Params, b: Params, n: int) -> Params:
+    """Parameter-averaging merge: a += (b - a) / n.
+
+    Parity: reference MultiLayerNetwork.merge (core/nn/multilayer/
+    MultiLayerNetwork.java:1361) and BaseLayer.merge (:270) — the primitive
+    the distributed parameter-averaging runtimes are built on.
+    """
+    return jax.tree_util.tree_map(lambda x, y: x + (y - x) / n, a, b)

@@ -1,0 +1,127 @@
+"""Base feed-forward layers + the layer registry.
+
+Parity: reference core/nn/layers/BaseLayer.java (dense affine + string-named
+activation, :176/:202), OutputLayer.java (losses via ops.losses — gradients
+come from jax.grad instead of the hand-coded per-loss switch at :131-163),
+and the factory dispatch in core/nn/layers/factory/LayerFactories.java:20-30
+(here: a name -> class registry resolved from conf.layer).
+
+TPU notes: the affine runs in `conf.compute_dtype` (bfloat16 on the MXU when
+configured) with float32 parameters; dropout/dropconnect use explicit PRNG
+keys.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.activations import apply_activation
+from deeplearning4j_tpu.ops.initializers import init_weights
+from deeplearning4j_tpu.ops.losses import loss_fn
+
+LAYER_REGISTRY: Dict[str, Type["BaseLayer"]] = {}
+
+
+def register_layer(name: str) -> Callable[[Type["BaseLayer"]], Type["BaseLayer"]]:
+    def deco(cls):
+        LAYER_REGISTRY[name] = cls
+        cls.layer_name = name
+        return cls
+
+    return deco
+
+
+def make_layer(conf) -> "BaseLayer":
+    """Resolve conf.layer through the registry (LayerFactories parity)."""
+    try:
+        cls = LAYER_REGISTRY[conf.layer.lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown layer type {conf.layer!r}; known: {sorted(LAYER_REGISTRY)}"
+        ) from None
+    return cls(conf)
+
+
+@register_layer("dense")
+class BaseLayer:
+    """Dense affine + activation. Reference core/nn/layers/BaseLayer.java."""
+
+    def __init__(self, conf):
+        self.conf = conf
+
+    # ------------------------------------------------------------- params
+    def param_shapes(self) -> Dict[str, tuple]:
+        c = self.conf
+        return {"W": (c.n_in, c.n_out), "b": (1, c.n_out)}
+
+    def init_params(self, key: jax.Array):
+        """DefaultParamInitializer parity: W via weight-init scheme, b zeros
+        (reference core/nn/params/DefaultParamInitializer.java:29-50)."""
+        c = self.conf
+        shapes = self.param_shapes()
+        keys = jax.random.split(key, len(shapes))
+        params = {}
+        for (name, shape), k in zip(sorted(shapes.items()), keys):
+            if name.startswith("b"):
+                params[name] = jnp.zeros(shape, jnp.dtype(c.dtype))
+            else:
+                params[name] = init_weights(k, shape, c.weight_init, c.dist,
+                                            jnp.dtype(c.dtype))
+            self.conf.variable(name)
+        return params
+
+    # ------------------------------------------------------------ forward
+    def _affine(self, params, x, W_name="W", b_name="b"):
+        c = self.conf
+        cd = jnp.dtype(c.compute_dtype)
+        y = jnp.dot(x.astype(cd), params[W_name].astype(cd),
+                    preferred_element_type=jnp.float32)
+        return y.astype(jnp.dtype(c.dtype)) + params[b_name]
+
+    def pre_output(self, params, x, *, rng: Optional[jax.Array] = None,
+                   training: bool = False):
+        """x @ W + b, with optional dropconnect on W when training
+        (reference MultiLayerNetwork dropconnect mask :515)."""
+        if training and self.conf.use_drop_connect and self.conf.dropout > 0 \
+                and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - self.conf.dropout,
+                                        params["W"].shape)
+            params = dict(params, W=params["W"] * keep)
+        return self._affine(params, x)
+
+    def activate(self, params, x, *, rng: Optional[jax.Array] = None,
+                 training: bool = False):
+        c = self.conf
+        drop_rng = pre_rng = None
+        if rng is not None:
+            pre_rng, drop_rng = jax.random.split(rng)
+        act = apply_activation(c.activation_function,
+                               self.pre_output(params, x, rng=pre_rng,
+                                               training=training))
+        if training and c.dropout > 0 and not c.use_drop_connect \
+                and drop_rng is not None:
+            keep = jax.random.bernoulli(drop_rng, 1.0 - c.dropout, act.shape)
+            act = act * keep / (1.0 - c.dropout)
+        return act
+
+    __call__ = activate
+
+
+@register_layer("output")
+class OutputLayer(BaseLayer):
+    """Classification/regression head.
+
+    Reference core/nn/layers/OutputLayer.java — `score` (:72) is the configured
+    loss over the activated output plus L2; the per-loss hand-coded gradient
+    switch (:131-163) is replaced by autodiff over `loss`.
+    """
+
+    def loss(self, params, x, labels, *, rng=None, training: bool = False):
+        """Unregularized data loss; L2 lives in MultiLayerNetwork.loss_fn so
+        it is applied exactly once per layer across all solver paths."""
+        c = self.conf
+        out = self.activate(params, x, rng=rng, training=training)
+        return loss_fn(c.loss_function)(labels, out)

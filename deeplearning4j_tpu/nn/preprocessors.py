@@ -1,0 +1,81 @@
+"""Input/output pre-processors between layers.
+
+Parity: reference core/nn/conf/preprocessor/ (`ReshapePreProcessor`,
+`BinomialSamplingPreProcessor`, `AggregatePreProcessor`, `OutputPreProcessor`)
+and the convolution reshape pair (core/nn/layers/convolution/preprocessor/
+ConvolutionInputPreProcessor.java / ConvolutionPostProcessor.java).
+Each is a pure callable on arrays; serialized by registry name + args.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.config.multi_layer_configuration import register_preprocessor
+
+
+class PreProcessor:
+    registry_name = "base"
+
+    def serializable_args(self) -> dict:
+        return {}
+
+    def __call__(self, x, *, rng=None):
+        raise NotImplementedError
+
+
+@register_preprocessor("reshape")
+class ReshapePreProcessor(PreProcessor):
+    """Reshape to a fixed shape, keeping the batch dimension if `keep_batch`."""
+
+    def __init__(self, shape: Sequence[int], keep_batch: bool = True):
+        self.shape = list(shape)
+        self.keep_batch = keep_batch
+
+    def serializable_args(self):
+        return {"shape": self.shape, "keep_batch": self.keep_batch}
+
+    def __call__(self, x, *, rng=None):
+        if self.keep_batch:
+            return jnp.reshape(x, (x.shape[0], *self.shape))
+        return jnp.reshape(x, tuple(self.shape))
+
+
+@register_preprocessor("binomial_sampling")
+class BinomialSamplingPreProcessor(PreProcessor):
+    """Bernoulli-sample activations (DBN-style stochastic binary units).
+    With no rng key (inference/scoring) passes the probabilities through —
+    the expectation of the sample."""
+
+    def __call__(self, x, *, rng=None):
+        if rng is None:
+            return x
+        return jax.random.bernoulli(rng, jnp.clip(x, 0.0, 1.0)).astype(x.dtype)
+
+
+@register_preprocessor("conv_input")
+class ConvolutionInputPreProcessor(PreProcessor):
+    """Flat (B, rows*cols) -> NCHW (B, channels, rows, cols) for conv layers.
+
+    Parity: reference ConvolutionInputPreProcessor.java.
+    """
+
+    def __init__(self, rows: int, cols: int, channels: int = 1):
+        self.rows, self.cols, self.channels = rows, cols, channels
+
+    def serializable_args(self):
+        return {"rows": self.rows, "cols": self.cols, "channels": self.channels}
+
+    def __call__(self, x, *, rng=None):
+        return jnp.reshape(x, (x.shape[0], self.channels, self.rows, self.cols))
+
+
+@register_preprocessor("conv_output")
+class ConvolutionPostProcessor(PreProcessor):
+    """NCHW -> flat (B, C*H*W) after a conv stack (ConvolutionPostProcessor.java)."""
+
+    def __call__(self, x, *, rng=None):
+        return jnp.reshape(x, (x.shape[0], -1))

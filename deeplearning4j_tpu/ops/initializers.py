@@ -1,0 +1,74 @@
+"""Weight initialization schemes.
+
+Parity with reference core/nn/weights/WeightInit.java enum
+{VI, ZERO, SIZE, DISTRIBUTION, NORMALIZED, UNIFORM} and
+`WeightInitUtil.initWeights`. RNG discipline is TPU-native: explicit
+`jax.random` keys instead of the reference's shared `conf.rng`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class WeightInit:
+    VI = "vi"
+    ZERO = "zero"
+    SIZE = "size"
+    DISTRIBUTION = "distribution"
+    NORMALIZED = "normalized"
+    UNIFORM = "uniform"
+
+
+def _fans(shape: Tuple[int, ...]) -> Tuple[int, int]:
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    if len(shape) == 4:  # conv OIHW
+        receptive = shape[2] * shape[3]
+        return shape[1] * receptive, shape[0] * receptive
+    n = int(jnp.prod(jnp.array(shape)))
+    return n, n
+
+
+def init_weights(
+    key: jax.Array,
+    shape: Tuple[int, ...],
+    scheme: str = WeightInit.VI,
+    dist: Optional[dict] = None,
+    dtype=jnp.float32,
+):
+    """Initialize a weight tensor.
+
+    `dist` mirrors the reference's `conf.dist` (a RealDistribution) for the
+    DISTRIBUTION scheme: {"type": "normal"|"uniform", ...params}.
+    """
+    scheme = scheme.lower()
+    fan_in, fan_out = _fans(shape)
+    if scheme == WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if scheme == WeightInit.VI:
+        # Variance-scaled init (reference WeightInitUtil VI: uniform in
+        # +-sqrt(6/(fanIn+fanOut)), the Glorot/Bengio scheme).
+        r = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -r, r)
+    if scheme == WeightInit.SIZE:
+        r = 1.0 / jnp.sqrt(float(fan_in))
+        return jax.random.uniform(key, shape, dtype, -r, r)
+    if scheme == WeightInit.UNIFORM:
+        r = 1.0 / jnp.sqrt(float(fan_in))
+        return jax.random.uniform(key, shape, dtype, -r, r)
+    if scheme == WeightInit.NORMALIZED:
+        return (jax.random.uniform(key, shape, dtype) - 0.5) / float(fan_in)
+    if scheme == WeightInit.DISTRIBUTION:
+        d = dist or {"type": "normal", "mean": 0.0, "std": 0.01}
+        if d.get("type", "normal") == "uniform":
+            return jax.random.uniform(
+                key, shape, dtype, d.get("lower", -1.0), d.get("upper", 1.0)
+            )
+        return d.get("mean", 0.0) + d.get("std", 0.01) * jax.random.normal(
+            key, shape, dtype
+        )
+    raise ValueError(f"Unknown weight init scheme {scheme!r}")

@@ -1,0 +1,85 @@
+"""Loss functions.
+
+Parity with the reference's `LossFunctions.LossFunction` set and the per-loss
+gradient switch in reference core/nn/layers/OutputLayer.java:131-163
+(MCXENT / XENT / MSE / EXPLL / RMSE_XENT / SQUARED_LOSS /
+NEGATIVELOGLIKELIHOOD / RECONSTRUCTION_CROSSENTROPY). Unlike the reference,
+gradients come from jax.grad — only the scalar score is defined here.
+
+All losses return the mean per-example score (the reference divides by the
+number of examples in OutputLayer.score, OutputLayer.java:72-101) and are
+written NaN-safe the way the reference scrubs NaNs via
+`BooleanIndexing.applyWhere(output, isNan, EPS)` (OutputLayer.java:75,:89):
+probabilities are clipped to [EPS, 1-EPS] before logs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+EPS = 1e-7
+
+
+def _clip(p):
+    return jnp.clip(p, EPS, 1.0 - EPS)
+
+
+def mcxent(labels, output):
+    """Multi-class cross entropy: -sum(labels * log(p))."""
+    return -jnp.sum(labels * jnp.log(_clip(output))) / labels.shape[0]
+
+
+def xent(labels, output):
+    """Binary cross entropy."""
+    p = _clip(output)
+    return -jnp.sum(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p)) / labels.shape[0]
+
+
+def mse(labels, output):
+    return jnp.sum(jnp.square(labels - output)) / (2.0 * labels.shape[0])
+
+
+def expll(labels, output):
+    """Exponential log-likelihood (Poisson-style): sum(p - labels*log(p))."""
+    p = _clip(output)
+    return jnp.sum(p - labels * jnp.log(p)) / labels.shape[0]
+
+
+def rmse_xent(labels, output):
+    return jnp.sum(jnp.sqrt(jnp.square(labels - output) + EPS)) / labels.shape[0]
+
+
+def squared_loss(labels, output):
+    return jnp.sum(jnp.square(labels - output)) / labels.shape[0]
+
+
+def negativeloglikelihood(labels, output):
+    """NLL over softmax output — same functional form as MCXENT here."""
+    return -jnp.sum(labels * jnp.log(_clip(output))) / labels.shape[0]
+
+
+def reconstruction_crossentropy(labels, output):
+    """Reconstruction cross-entropy used by pretrain layers (AE/RBM score)."""
+    p = _clip(output)
+    return -jnp.sum(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p)) / labels.shape[0]
+
+
+LOSS_FUNCTIONS = {
+    "mcxent": mcxent,
+    "xent": xent,
+    "mse": mse,
+    "expll": expll,
+    "rmse_xent": rmse_xent,
+    "squared_loss": squared_loss,
+    "negativeloglikelihood": negativeloglikelihood,
+    "reconstruction_crossentropy": reconstruction_crossentropy,
+}
+
+
+def loss_fn(name: str):
+    try:
+        return LOSS_FUNCTIONS[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown loss function {name!r}; known: {sorted(LOSS_FUNCTIONS)}"
+        ) from None

@@ -1,0 +1,7 @@
+from deeplearning4j_tpu.optimize.solver import Solver  # noqa: F401
+from deeplearning4j_tpu.optimize.updater import GradientUpdater  # noqa: F401
+from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
+    IterationListener,
+    ScoreIterationListener,
+    ComposableIterationListener,
+)

@@ -1,0 +1,46 @@
+"""Iteration listeners.
+
+Parity: reference core/optimize/api/IterationListener.java (hook invoked from
+BaseOptimizer.java:168-170), ScoreIterationListener (listeners/
+ScoreIterationListener.java:41), ComposableIterationListener.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable
+
+log = logging.getLogger(__name__)
+
+
+class IterationListener:
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        raise NotImplementedError
+
+
+class ScoreIterationListener(IterationListener):
+    def __init__(self, print_every: int = 10):
+        self.print_every = max(1, print_every)
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        if iteration % self.print_every == 0:
+            log.info("Score at iteration %d is %s", iteration, score)
+
+
+class ComposableIterationListener(IterationListener):
+    def __init__(self, listeners: Iterable[IterationListener]):
+        self.listeners = list(listeners)
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        for listener in self.listeners:
+            listener.iteration_done(model, iteration, score)
+
+
+class CollectScoresListener(IterationListener):
+    """Test/diagnostic helper: records every (iteration, score)."""
+
+    def __init__(self):
+        self.scores = []
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        self.scores.append((iteration, float(score)))

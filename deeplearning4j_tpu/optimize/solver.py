@@ -1,0 +1,57 @@
+"""Solver facade: pick an optimizer from conf.optimization_algo.
+
+Parity: reference core/optimize/Solver.java:37-60 (`Solver.Builder`, the
+algorithm switch in `getOptimizer`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.config.neural_net_configuration import OptimizationAlgorithm
+from deeplearning4j_tpu.optimize.solvers import (
+    BaseOptimizer,
+    ConjugateGradient,
+    GradientAscent,
+    IterationGradientDescent,
+    LBFGS,
+    StochasticHessianFree,
+)
+
+_ALGOS = {
+    OptimizationAlgorithm.GRADIENT_DESCENT: GradientAscent,
+    OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT: IterationGradientDescent,
+    OptimizationAlgorithm.CONJUGATE_GRADIENT: ConjugateGradient,
+    OptimizationAlgorithm.LBFGS: LBFGS,
+    OptimizationAlgorithm.HESSIAN_FREE: StochasticHessianFree,
+}
+
+
+class Solver:
+    def __init__(self, conf, loss: Callable[[jnp.ndarray], jnp.ndarray],
+                 listeners: Optional[Sequence] = None,
+                 terminations: Optional[Sequence] = None,
+                 model=None, **optimizer_kwargs):
+        self.conf = conf
+        self.loss = loss
+        self.listeners = listeners
+        self.terminations = terminations
+        self.model = model
+        self.optimizer_kwargs = optimizer_kwargs
+
+    def get_optimizer(self) -> BaseOptimizer:
+        algo = self.conf.optimization_algo.lower()
+        try:
+            cls = _ALGOS[algo]
+        except KeyError:
+            raise ValueError(
+                f"Unknown optimization algorithm {algo!r}; known: {sorted(_ALGOS)}"
+            ) from None
+        return cls(self.conf, self.loss, listeners=self.listeners,
+                   terminations=self.terminations, model=self.model,
+                   **self.optimizer_kwargs)
+
+    def optimize(self, params):
+        return self.get_optimizer().optimize(params)

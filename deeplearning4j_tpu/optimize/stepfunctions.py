@@ -1,0 +1,36 @@
+"""Step functions: how a search direction is applied to parameters.
+
+Parity: reference core/optimize/stepfunctions/ ×5 (DefaultStepFunction,
+NegativeDefaultStepFunction, GradientStepFunction, NegativeGradientStepFunction,
+StepFunction iface). Pure pytree ops.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def default_step(params, direction, step: float = 1.0):
+    """params + step * direction (reference DefaultStepFunction)."""
+    return jax.tree_util.tree_map(lambda p, d: p + step * d, params, direction)
+
+
+def negative_default_step(params, direction, step: float = 1.0):
+    return jax.tree_util.tree_map(lambda p, d: p - step * d, params, direction)
+
+
+def gradient_step(params, direction, step: float = 1.0):
+    """params + direction, ignoring step (reference GradientStepFunction)."""
+    return jax.tree_util.tree_map(lambda p, d: p + d, params, direction)
+
+
+def negative_gradient_step(params, direction, step: float = 1.0):
+    return jax.tree_util.tree_map(lambda p, d: p - d, params, direction)
+
+
+STEP_FUNCTIONS = {
+    "default": default_step,
+    "negative_default": negative_default_step,
+    "gradient": gradient_step,
+    "negative_gradient": negative_gradient_step,
+}

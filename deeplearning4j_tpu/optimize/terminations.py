@@ -1,0 +1,41 @@
+"""Termination conditions.
+
+Parity: reference core/optimize/terminations/ — `EpsTermination` (relative
+score change below eps), `ZeroDirection` (zero gradient direction),
+`Norm2Termination` (gradient L2 norm below tolerance), checked each iteration
+in BaseOptimizer.optimize (BaseOptimizer.java:176-186).
+"""
+
+from __future__ import annotations
+
+import math
+
+
+class TerminationCondition:
+    def terminate(self, new_score: float, old_score: float, grad_norm: float) -> bool:
+        raise NotImplementedError
+
+
+class EpsTermination(TerminationCondition):
+    def __init__(self, eps: float = 1e-4, tolerance: float = 1e-8):
+        self.eps = eps
+        self.tolerance = tolerance
+
+    def terminate(self, new_score, old_score, grad_norm) -> bool:
+        if not (math.isfinite(new_score) and math.isfinite(old_score)):
+            return False
+        denom = abs(old_score) + abs(new_score) + self.tolerance
+        return 2.0 * abs(new_score - old_score) / denom < self.eps
+
+
+class ZeroDirection(TerminationCondition):
+    def terminate(self, new_score, old_score, grad_norm) -> bool:
+        return grad_norm == 0.0
+
+
+class Norm2Termination(TerminationCondition):
+    def __init__(self, gradient_tolerance: float = 1e-8):
+        self.gradient_tolerance = gradient_tolerance
+
+    def terminate(self, new_score, old_score, grad_norm) -> bool:
+        return grad_norm < self.gradient_tolerance

@@ -1,0 +1,118 @@
+"""Per-variable gradient adjustment.
+
+Parity: reference core/optimize/GradientAdjustment.updateGradientAccordingToParams
+(GradientAdjustment.java:66-113): AdaGrad-or-plain-lr scaling, momentum with an
+iteration-indexed schedule, optional unit-norm constraint.
+
+Two deliberate deltas: (a) the reference divides the raw gradient by the batch
+size because its losses are sums; our losses (ops.losses) are already
+per-example means, so no second division happens by default
+(`divide_by_batch=False`); (b) the reference's L2 term lives in the LOSS here
+(MultiLayerNetwork.loss_fn / pretrain losses), not in the updater, so every
+solver path — including the loss-only line-search family — sees the same
+regularized objective exactly once.
+
+Implemented as a pure (state, grads) -> (updates, state) transform over
+pytrees so it jits and shards; state is {hist, velocity} mirroring ND4J's
+AdaGrad historicalGradient and the momentum buffer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+ADAGRAD_EPS = 1e-6
+
+
+class UpdaterState(NamedTuple):
+    hist: Any  # adagrad accumulator, same pytree as params
+    velocity: Any  # momentum buffer
+    iteration: jnp.ndarray  # scalar int32
+
+
+class GradientUpdater:
+    """Builds jit-friendly update transforms from a NeuralNetConfiguration."""
+
+    def __init__(self, conf, divide_by_batch: bool = False):
+        self.conf = conf
+        self.divide_by_batch = divide_by_batch
+
+    def init(self, params) -> UpdaterState:
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return UpdaterState(hist=zeros, velocity=zeros,
+                            iteration=jnp.zeros((), jnp.int32))
+
+    def _momentum_at(self, iteration):
+        """Piecewise-constant momentum schedule (GradientAdjustment.java:79)."""
+        c = self.conf
+        m = jnp.asarray(c.momentum, jnp.float32)
+        for after, value in sorted(c.momentum_after.items()):
+            m = jnp.where(iteration >= after, value, m)
+        return m
+
+    def update(self, grads, state: UpdaterState, params,
+               batch_size: int = 1):
+        """Returns (updates, new_state); apply as params -= updates (minimize)."""
+        c = self.conf
+        it = state.iteration
+
+        if c.use_adagrad:
+            hist = jax.tree_util.tree_map(
+                lambda h, g: h + jnp.square(g), state.hist, grads)
+            scaled = jax.tree_util.tree_map(
+                lambda g, h: c.lr * g / (jnp.sqrt(h) + ADAGRAD_EPS),
+                grads, hist)
+        else:
+            hist = state.hist
+            scaled = jax.tree_util.tree_map(lambda g: c.lr * g, grads)
+
+        m = self._momentum_at(it)
+        velocity = jax.tree_util.tree_map(
+            lambda v, g: m * v + g, state.velocity, scaled)
+        updates = velocity
+
+        if c.constrain_gradient_to_unit_norm:
+            flat, _ = jax.flatten_util.ravel_pytree(updates)
+            norm = jnp.linalg.norm(flat) + 1e-12
+            updates = jax.tree_util.tree_map(lambda u: u / norm, updates)
+
+        if self.divide_by_batch and batch_size > 1:
+            updates = jax.tree_util.tree_map(
+                lambda u: u / batch_size, updates)
+
+        return updates, UpdaterState(hist=hist, velocity=velocity,
+                                     iteration=it + 1)
+
+
+class NetworkGradientUpdater:
+    """Per-layer GradientAdjustment over a {layer index -> param table} pytree.
+
+    The reference adjusts gradients per layer with THAT layer's conf
+    (BaseOptimizer/GradientAdjustment run inside each layer's solver), so
+    per-layer overrides like `ListBuilder.override(0, lr=...)` must be honored
+    on the whole-network backprop path too. Each layer gets its own
+    GradientUpdater; state is {layer index -> UpdaterState}.
+    """
+
+    def __init__(self, confs_by_key: Dict[str, object],
+                 divide_by_batch: bool = False):
+        self.updaters = {k: GradientUpdater(c, divide_by_batch)
+                         for k, c in confs_by_key.items()}
+
+    @classmethod
+    def for_network(cls, network) -> "NetworkGradientUpdater":
+        return cls({str(i): layer.conf
+                    for i, layer in enumerate(network.layers)})
+
+    def init(self, params) -> Dict[str, UpdaterState]:
+        return {k: upd.init(params[k]) for k, upd in self.updaters.items()}
+
+    def update(self, grads, state, params, batch_size: int = 1):
+        updates, new_state = {}, {}
+        for k, upd in self.updaters.items():
+            updates[k], new_state[k] = upd.update(grads[k], state[k],
+                                                  params[k], batch_size)
+        return updates, new_state

@@ -1,0 +1,52 @@
+"""Device-mesh helpers.
+
+The TPU-native replacement for the reference's cluster formation (Akka
+cluster join, DeepLearning4jDistributed.java:143-210; Spark context; YARN
+container allocation): a `jax.sharding.Mesh` over the slice's chips, with
+named axes for data/model/pipeline parallelism. Collectives ride ICI inside
+a slice and DCN across slices — no NCCL/MPI, XLA inserts them from sharding
+annotations.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh. Default: all local devices on one data axis.
+
+    `axes` maps axis name -> size; sizes must multiply to the device count
+    (one axis may be -1 to infer).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {DATA_AXIS: len(devices)}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        raise ValueError(f"Mesh axes {dict(zip(names, sizes))} need {total} "
+                         f"devices, have {len(devices)}")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard the leading (batch) dimension over `axis`."""
+    return NamedSharding(mesh, P(axis))

@@ -1,0 +1,29 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's distributed-without-a-cluster test strategy (SURVEY §4:
+embedded Hazelcast tracker / IRUnit in-process cluster) — multi-chip sharding
+logic runs in one process against fake devices. Must set flags BEFORE jax
+imports anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = os.environ.get("DL4J_TPU_TEST_PLATFORM", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The image pre-imports jax._src.config at interpreter start, freezing the
+# env-var snapshot (JAX_PLATFORMS=axon) — override through the live config.
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.RandomState(0)

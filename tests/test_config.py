@@ -1,0 +1,62 @@
+"""Config round-trip tests (reference NeuralNetConfigurationTest /
+MultiLayerNeuralNetConfigurationTest)."""
+
+import pytest
+
+from deeplearning4j_tpu.config import MultiLayerConfiguration, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.preprocessors import ReshapePreProcessor
+
+
+def test_conf_json_round_trip():
+    conf = NeuralNetConfiguration(lr=0.01, momentum=0.9,
+                                  momentum_after={5: 0.99}, l2=1e-4,
+                                  n_in=784, n_out=10, layer="output",
+                                  loss_function="mcxent",
+                                  activation_function="softmax")
+    restored = NeuralNetConfiguration.from_json(conf.to_json())
+    assert restored == conf
+    assert restored.momentum_after == {5: 0.99}
+
+
+def test_conf_unknown_field_rejected():
+    with pytest.raises(ValueError):
+        NeuralNetConfiguration.from_dict({"not_a_field": 1})
+
+
+def test_builder_fluent():
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.05).n_in(4).n_out(3).activation_function("tanh").build())
+    assert conf.lr == 0.05 and conf.n_in == 4 and conf.activation_function == "tanh"
+
+
+def test_list_builder_overrides():
+    mlc = (NeuralNetConfiguration.builder()
+           .lr(0.1).n_in(4).activation_function("tanh")
+           .list(3)
+           .hidden_layer_sizes([8, 6])
+           .override(2, layer="output", loss_function="mcxent",
+                     activation_function="softmax", n_out=3)
+           .build())
+    assert mlc.n_layers == 3
+    assert mlc.confs[2].layer == "output"
+    assert mlc.confs[0].activation_function == "tanh"
+
+
+def test_multilayer_json_round_trip_with_preprocessor():
+    mlc = (NeuralNetConfiguration.builder()
+           .n_in(16).list(2).hidden_layer_sizes([8])
+           .override(1, layer="output", n_out=2)
+           .build())
+    mlc.input_preprocessors[0] = ReshapePreProcessor([16])
+    restored = MultiLayerConfiguration.from_json(mlc.to_json())
+    assert restored.n_layers == 2
+    assert restored.confs == mlc.confs
+    assert 0 in restored.input_preprocessors
+    assert restored.input_preprocessors[0].shape == [16]
+
+
+def test_momentum_schedule():
+    conf = NeuralNetConfiguration(momentum=0.5, momentum_after={3: 0.9, 7: 0.99})
+    assert conf.momentum_for_iteration(0) == 0.5
+    assert conf.momentum_for_iteration(3) == 0.9
+    assert conf.momentum_for_iteration(10) == 0.99

@@ -1,0 +1,104 @@
+"""Dataset pipeline tests (reference datasets/DataSetTest,
+CSVDataSetIteratorTest, RecordReaderDataSetiteratorTest, MnistManager IDX)."""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets import (
+    CSVDataSetIterator,
+    ListDataSetIterator,
+    MnistDataSetIterator,
+    MultipleEpochsIterator,
+    SamplingDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.api import DataSet, ReconstructionDataSetIterator
+from deeplearning4j_tpu.datasets.mnist import read_idx_images, read_idx_labels
+
+
+def _toy_ds(n=20, d=4, c=2):
+    rng = np.random.RandomState(0)
+    labels = np.zeros((n, c), np.float32)
+    labels[np.arange(n), rng.randint(0, c, n)] = 1
+    return DataSet(rng.rand(n, d).astype(np.float32), labels)
+
+
+def test_list_iterator_batching():
+    it = ListDataSetIterator(_toy_ds(20), batch_size=6)
+    sizes = [b.num_examples for b in it]
+    assert sizes == [6, 6, 6, 2]
+    it.reset()
+    assert it.next().num_examples == 6
+
+
+def test_sampling_iterator():
+    it = SamplingDataSetIterator(_toy_ds(10), batch_size=4, total_batches=3)
+    batches = list(it)
+    assert len(batches) == 3
+    assert all(b.features.shape == (4, 4) for b in batches)
+
+
+def test_multiple_epochs_iterator():
+    inner = ListDataSetIterator(_toy_ds(8), batch_size=4)
+    it = MultipleEpochsIterator(3, inner)
+    assert len(list(it)) == 6
+
+
+def test_reconstruction_iterator():
+    it = ReconstructionDataSetIterator(ListDataSetIterator(_toy_ds(8), 4))
+    ds = next(iter(it))
+    np.testing.assert_array_equal(ds.features, ds.labels)
+
+
+def test_dataset_ops():
+    ds = _toy_ds(10)
+    train, test = ds.split_test_and_train(7)
+    assert train.num_examples == 7 and test.num_examples == 3
+    merged = DataSet.merge([train, test])
+    assert merged.num_examples == 10
+    assert ds.sample(5).num_examples == 5
+
+
+def test_idx_round_trip(tmp_path):
+    """Write IDX files in the real format, read them back (MnistDbFile parity)."""
+    images = (np.arange(2 * 28 * 28) % 255).astype(np.uint8)
+    img_path = os.path.join(tmp_path, "train-images-idx3-ubyte.gz")
+    with gzip.open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 2, 28, 28))
+        f.write(images.tobytes())
+    lbl_path = os.path.join(tmp_path, "train-labels-idx1-ubyte.gz")
+    with gzip.open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, 2))
+        f.write(np.array([3, 7], np.uint8).tobytes())
+    imgs = read_idx_images(img_path)
+    assert imgs.shape == (2, 784)
+    labels = read_idx_labels(lbl_path)
+    np.testing.assert_array_equal(labels, [3, 7])
+    it = MnistDataSetIterator(batch_size=2, num_examples=2,
+                              data_dir=str(tmp_path))
+    ds = it.next()
+    assert ds.features.shape == (2, 784)
+    assert float(ds.features.max()) <= 1.0
+    np.testing.assert_array_equal(ds.labels.argmax(-1), [3, 7])
+
+
+def test_mnist_synthetic_fallback():
+    it = MnistDataSetIterator(batch_size=32, num_examples=64,
+                              data_dir="/nonexistent")
+    ds = it.next()
+    assert ds.features.shape == (32, 784)
+    assert ds.labels.shape == (32, 10)
+
+
+def test_csv_iterator(tmp_path):
+    path = os.path.join(tmp_path, "data.csv")
+    with open(path, "w") as f:
+        for i in range(10):
+            f.write(f"{i * 0.1:.2f},{i * 0.2:.2f},{i % 2}\n")
+    it = CSVDataSetIterator(path, batch_size=5, label_index=-1, num_classes=2)
+    assert it.input_columns() == 2
+    ds = it.next()
+    assert ds.features.shape == (5, 2)
+    assert ds.labels.shape == (5, 2)

@@ -1,0 +1,50 @@
+"""Evaluation metric tests (reference eval/EvalTest.java)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.eval import ConfusionMatrix, Evaluation
+
+
+def onehot(idx, n=3):
+    out = np.zeros((len(idx), n), np.float32)
+    out[np.arange(len(idx)), idx] = 1.0
+    return out
+
+
+def test_perfect_predictions():
+    ev = Evaluation()
+    truth = onehot([0, 1, 2, 1])
+    ev.eval(truth, truth)
+    assert ev.accuracy() == 1.0
+    assert ev.f1() == 1.0
+    assert ev.precision() == 1.0 and ev.recall() == 1.0
+
+
+def test_known_confusion():
+    ev = Evaluation()
+    truth = onehot([0, 0, 1, 1])
+    guess = onehot([0, 1, 1, 1])
+    ev.eval(truth, guess)
+    assert ev.accuracy() == 0.75
+    assert ev.recall(0) == 0.5 and ev.recall(1) == 1.0
+    assert ev.precision(1) == 2 / 3
+    assert "Accuracy" in ev.stats()
+
+
+def test_batched_accumulation():
+    ev = Evaluation()
+    ev.eval(onehot([0]), onehot([0]))
+    ev.eval(onehot([1]), onehot([2]))
+    assert ev.confusion.total() == 2
+    assert ev.accuracy() == 0.5
+
+
+def test_confusion_matrix_counts():
+    cm = ConfusionMatrix([0, 1])
+    cm.add(0, 1)
+    cm.add(0, 1)
+    cm.add(1, 1)
+    assert cm.count(0, 1) == 2
+    assert cm.actual_total(0) == 2
+    assert cm.predicted_total(1) == 3
+    assert "actual" in str(cm)

@@ -1,0 +1,166 @@
+"""End-to-end network tests (reference nn/multilayer/MultiLayerTest.java —
+DBN on Iris end-to-end; here: MLP convergence on Iris + MNIST-shaped data,
+pack/unpack, merge, serialization)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.config import MultiLayerConfiguration, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets import IrisDataSetIterator, ListDataSetIterator
+from deeplearning4j_tpu.datasets.iris import load_iris
+from deeplearning4j_tpu.datasets.mnist import load_mnist
+from deeplearning4j_tpu.eval import Evaluation
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def mlp_conf(n_in=4, hidden=(8,), n_out=3, lr=0.1, iters=5,
+             pretrain=False, algo="iteration_gradient_descent"):
+    b = (NeuralNetConfiguration.builder()
+         .lr(lr).n_in(n_in).activation_function("tanh")
+         .optimization_algo(algo)
+         .num_iterations(iters)
+         .list(len(hidden) + 1)
+         .hidden_layer_sizes(list(hidden))
+         .override(len(hidden), layer="output", loss_function="mcxent",
+                   activation_function="softmax", n_out=n_out)
+         .pretrain(pretrain))
+    return b.build()
+
+
+def test_init_shapes_and_param_count():
+    net = MultiLayerNetwork(mlp_conf(n_in=4, hidden=(8, 6), n_out=3))
+    pt = net.param_table
+    assert pt["0"]["W"].shape == (4, 8)
+    assert pt["1"]["W"].shape == (8, 6)
+    assert pt["2"]["W"].shape == (6, 3)
+    expected = 4 * 8 + 8 + 8 * 6 + 6 + 6 * 3 + 3
+    assert net.num_params() == expected
+
+
+def test_pack_unpack_round_trip():
+    net = MultiLayerNetwork(mlp_conf())
+    flat = net.params()
+    net2 = MultiLayerNetwork(mlp_conf())
+    net2.set_parameters(flat)
+    np.testing.assert_allclose(net.params(), net2.params())
+    out = np.random.RandomState(0).rand(5, 4).astype(np.float32)
+    np.testing.assert_allclose(net.output(out), net2.output(out), rtol=1e-6)
+
+
+def test_feed_forward_shapes():
+    net = MultiLayerNetwork(mlp_conf(n_in=4, hidden=(8,), n_out=3))
+    x = jnp.ones((10, 4))
+    acts = net.feed_forward(x)
+    assert [a.shape for a in acts] == [(10, 4), (10, 8), (10, 3)]
+    np.testing.assert_allclose(np.sum(np.asarray(acts[-1]), -1),
+                               np.ones(10), rtol=1e-5)
+
+
+def test_mlp_learns_iris():
+    data = load_iris()
+    net = MultiLayerNetwork(mlp_conf(lr=0.1, iters=1))
+    initial = net.score(data.features, data.labels)
+    it = ListDataSetIterator(data, batch_size=30)
+    net.fit(it, epochs=60)
+    final = net.score(data.features, data.labels)
+    assert final < initial * 0.5, (initial, final)
+
+    ev = Evaluation()
+    ev.eval(data.labels, np.asarray(net.output(data.features)))
+    assert ev.accuracy() > 0.85, ev.stats()
+    assert 0.0 < ev.f1() <= 1.0
+
+
+def test_mlp_learns_mnist_shaped():
+    data = load_mnist(num_examples=512)
+    conf = mlp_conf(n_in=784, hidden=(64,), n_out=10, lr=0.05, iters=1)
+    net = MultiLayerNetwork(conf)
+    it = ListDataSetIterator(data, batch_size=128)
+    net.fit(it, epochs=15)
+    ev = Evaluation()
+    ev.eval(data.labels, np.asarray(net.output(data.features)))
+    assert ev.accuracy() > 0.9, ev.stats()
+
+
+def test_merge_parameter_averaging():
+    a = MultiLayerNetwork(mlp_conf())
+    b = MultiLayerNetwork(mlp_conf())
+    b.set_parameters(a.params() + 2.0)
+    expected = a.params() + 1.0
+    a.merge(b, 2)  # a += (b-a)/2
+    np.testing.assert_allclose(a.params(), expected, rtol=1e-6)
+
+
+def test_conf_json_checkpoint_restore():
+    net = MultiLayerNetwork(mlp_conf())
+    data = load_iris(num_examples=30)
+    net.fit(data.features, data.labels)
+    js, flat = net.to_json(), net.params()
+    restored = MultiLayerNetwork.from_config_json(js, params=flat)
+    np.testing.assert_allclose(restored.params(), flat)
+    np.testing.assert_allclose(restored.output(data.features),
+                               net.output(data.features), rtol=1e-6)
+
+
+def test_predict_returns_classes():
+    net = MultiLayerNetwork(mlp_conf())
+    preds = net.predict(np.random.rand(7, 4).astype(np.float32))
+    assert preds.shape == (7,)
+    assert set(np.unique(preds)).issubset({0, 1, 2})
+
+
+def test_per_layer_lr_override_honored():
+    """ListBuilder.override(0, lr=0) must freeze layer 0 on the backprop
+    hot path (per-layer GradientAdjustment parity)."""
+    conf = mlp_conf(lr=0.1, iters=1)
+    conf.confs[0].lr = 0.0
+    net = MultiLayerNetwork(conf)
+    w0_before = np.asarray(net.param_table["0"]["W"]).copy()
+    w1_before = np.asarray(net.param_table["1"]["W"]).copy()
+    data = load_iris(num_examples=60)
+    net.fit(data.features, data.labels, epochs=3)
+    np.testing.assert_allclose(np.asarray(net.param_table["0"]["W"]), w0_before)
+    assert np.abs(np.asarray(net.param_table["1"]["W"]) - w1_before).max() > 1e-6
+
+
+def test_stochastic_preprocessor_on_last_layer_trains():
+    """loss_fn must thread rng keys through input preprocessors of the output
+    layer (regression: rng was dropped, crashing stochastic preprocessors)."""
+    from deeplearning4j_tpu.nn.preprocessors import BinomialSamplingPreProcessor
+
+    conf = mlp_conf(n_in=4, hidden=(8,), n_out=3, iters=1)
+    conf.input_preprocessors[1] = BinomialSamplingPreProcessor()
+    net = MultiLayerNetwork(conf)
+    data = load_iris(num_examples=30)
+    net.fit(data.features, data.labels)  # must not raise
+    assert np.isfinite(float(net.loss_fn(net._params, data.features,
+                                         data.labels)))
+
+
+def test_l2_applied_once():
+    """L2 lives in the loss only; the loss with l2>0 must exceed the data
+    loss by exactly 0.5*l2*sum(W^2) over weight (non-bias) params."""
+    conf = mlp_conf()
+    plain = MultiLayerNetwork(conf)
+    data = load_iris(num_examples=30)
+    base = plain.score(data.features, data.labels)
+    for c in conf.confs:
+        c.use_regularization, c.l2 = True, 0.1
+    reg = MultiLayerNetwork(conf)
+    reg.set_parameters(plain.params())
+    expected_penalty = sum(
+        0.5 * 0.1 * float((np.asarray(v) ** 2).sum())
+        for table in plain.param_table.values()
+        for name, v in table.items() if not name.startswith("b"))
+    got = reg.score(data.features, data.labels)
+    np.testing.assert_allclose(got - base, expected_penalty, rtol=1e-4)
+
+
+def test_iterator_contract():
+    it = IrisDataSetIterator(batch_size=50)
+    assert it.input_columns() == 4 and it.total_outcomes() == 3
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].features.shape == (50, 4)
+    it.reset()
+    assert it.has_next()

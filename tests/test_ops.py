@@ -1,0 +1,65 @@
+"""Op-surface tests: activations, losses, initializers (reference: known-value
+fixtures over the ND4J op surface, SURVEY §7 stage 1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import ACTIVATIONS, LOSS_FUNCTIONS, apply_activation, init_weights, loss_fn
+
+
+def test_activation_known_values():
+    x = jnp.array([[-1.0, 0.0, 2.0]])
+    np.testing.assert_allclose(apply_activation("relu", x),
+                               [[0.0, 0.0, 2.0]])
+    np.testing.assert_allclose(apply_activation("sigmoid", jnp.zeros((1, 2))),
+                               [[0.5, 0.5]])
+    np.testing.assert_allclose(apply_activation("hardtanh", x),
+                               [[-1.0, 0.0, 1.0]])
+    sm = apply_activation("softmax", x)
+    np.testing.assert_allclose(jnp.sum(sm, -1), [1.0], rtol=1e-6)
+
+
+def test_all_activations_finite():
+    x = jnp.linspace(-3, 3, 7).reshape(1, 7)
+    for name in ACTIVATIONS:
+        if name == "sqrt":
+            continue  # defined for non-negative input
+        y = apply_activation(name, x)
+        assert bool(jnp.all(jnp.isfinite(y))), name
+
+
+def test_unknown_activation_raises():
+    with pytest.raises(ValueError):
+        apply_activation("nope", jnp.zeros(1))
+
+
+def test_losses_known_values():
+    labels = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+    perfect = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+    assert float(loss_fn("mcxent")(labels, perfect)) < 1e-5
+    assert float(loss_fn("mse")(labels, perfect)) == 0.0
+    uniform = jnp.full((2, 2), 0.5)
+    np.testing.assert_allclose(loss_fn("mcxent")(labels, uniform),
+                               np.log(2.0), rtol=1e-5)
+
+
+def test_losses_all_differentiable():
+    labels = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+    out = jnp.array([[0.7, 0.3], [0.4, 0.6]])
+    for name in LOSS_FUNCTIONS:
+        g = jax.grad(lambda o: loss_fn(name)(labels, o))(out)
+        assert bool(jnp.all(jnp.isfinite(g))), name
+
+
+def test_weight_init_schemes():
+    key = jax.random.PRNGKey(0)
+    for scheme in ["vi", "zero", "size", "uniform", "normalized", "distribution"]:
+        w = init_weights(key, (64, 32), scheme)
+        assert w.shape == (64, 32)
+        assert bool(jnp.all(jnp.isfinite(w)))
+    assert float(jnp.abs(init_weights(key, (4, 4), "zero")).sum()) == 0.0
+    # VI bound: sqrt(6/(fan_in+fan_out))
+    w = init_weights(key, (100, 100), "vi")
+    assert float(jnp.max(jnp.abs(w))) <= np.sqrt(6 / 200) + 1e-6

@@ -1,0 +1,90 @@
+"""Optimizer tests: each solver minimizes a quadratic and trains a tiny model
+(reference: learning tests like AdaGradTest + solver behavior in
+BaseOptimizer)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.optimize.listeners import CollectScoresListener
+from deeplearning4j_tpu.optimize.solver import Solver
+from deeplearning4j_tpu.optimize.updater import GradientUpdater
+from deeplearning4j_tpu.optimize.terminations import EpsTermination, Norm2Termination
+
+
+def quadratic(vec):
+    # min at (1, -2, 3, 0.5)
+    target = jnp.array([1.0, -2.0, 3.0, 0.5])
+    return jnp.sum(jnp.square(vec - target))
+
+
+TARGET = np.array([1.0, -2.0, 3.0, 0.5])
+
+
+@pytest.mark.parametrize("algo,iters,tol", [
+    ("iteration_gradient_descent", 400, 0.5),
+    ("gradient_descent", 100, 1e-2),
+    ("conjugate_gradient", 50, 1e-2),
+    ("lbfgs", 50, 1e-2),
+    ("hessian_free", 20, 1e-3),
+])
+def test_solvers_minimize_quadratic(algo, iters, tol):
+    conf = NeuralNetConfiguration(optimization_algo=algo, num_iterations=iters,
+                                  lr=0.2, momentum=0.0, use_adagrad=True,
+                                  num_line_search_iterations=10)
+    params = jnp.zeros(4)
+    solver = Solver(conf, quadratic, terminations=[])
+    out, score = solver.optimize(params)
+    np.testing.assert_allclose(np.asarray(out), TARGET, atol=tol)
+    assert score < tol * 10
+
+
+def test_updater_adagrad_momentum_state():
+    conf = NeuralNetConfiguration(lr=0.1, momentum=0.9, use_adagrad=True)
+    upd = GradientUpdater(conf)
+    params = {"W": jnp.ones((2, 2))}
+    state = upd.init(params)
+    g = {"W": jnp.full((2, 2), 0.5)}
+    updates, state = upd.update(g, state, params)
+    # adagrad first step: lr * g / (|g| + eps) ~= lr
+    np.testing.assert_allclose(np.asarray(updates["W"]),
+                               np.full((2, 2), 0.1), rtol=1e-3)
+    assert int(state.iteration) == 1
+    # second identical step: momentum accumulates
+    updates2, state = upd.update(g, state, params)
+    assert float(updates2["W"][0, 0]) > float(updates["W"][0, 0])
+
+
+def test_momentum_schedule_in_updater():
+    conf = NeuralNetConfiguration(lr=0.1, momentum=0.0, use_adagrad=False,
+                                  momentum_after={2: 1.0})
+    upd = GradientUpdater(conf)
+    params = jnp.zeros(3)
+    state = upd.init(params)
+    g = jnp.ones(3)
+    for i in range(4):
+        updates, state = upd.update(g, state, params)
+    # after iteration >=2, momentum=1.0 accumulates velocity linearly
+    assert float(updates[0]) > 0.15
+
+
+def test_listener_collects_scores():
+    conf = NeuralNetConfiguration(optimization_algo="iteration_gradient_descent",
+                                  num_iterations=10, lr=0.1)
+    listener = CollectScoresListener()
+    solver = Solver(conf, quadratic, listeners=[listener], terminations=[])
+    solver.optimize(jnp.zeros(4))
+    assert len(listener.scores) == 10
+    assert listener.scores[-1][1] < listener.scores[0][1]
+
+
+def test_eps_termination_stops_early():
+    conf = NeuralNetConfiguration(optimization_algo="lbfgs", num_iterations=500,
+                                  num_line_search_iterations=10)
+    listener = CollectScoresListener()
+    solver = Solver(conf, quadratic, listeners=[listener],
+                    terminations=[EpsTermination(eps=1e-10),
+                                  Norm2Termination(1e-8)])
+    solver.optimize(jnp.zeros(4))
+    assert len(listener.scores) < 500

@@ -1,0 +1,51 @@
+"""Distributed training tests on the virtual 8-device CPU mesh
+(reference: BaseTestDistributed embedded-cluster strategy, SURVEY §4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import ListDataSetIterator
+from deeplearning4j_tpu.datasets.iris import load_iris
+from deeplearning4j_tpu.eval import Evaluation
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import DataParallelTrainer, make_mesh
+from tests.test_multilayer import mlp_conf
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh({"data": 4, "model": 2})
+    assert mesh.devices.shape == (4, 2)
+    mesh = make_mesh({"data": -1})
+    assert mesh.devices.shape == (len(jax.devices()),)
+
+
+def test_make_mesh_bad_axes():
+    with pytest.raises(ValueError):
+        make_mesh({"data": 3})  # 8 devices not divisible
+
+
+def test_data_parallel_training_matches_learning():
+    data = load_iris()
+    net = MultiLayerNetwork(mlp_conf(lr=0.1, iters=1))
+    initial = net.score(data.features, data.labels)
+    trainer = DataParallelTrainer(net, make_mesh({"data": 8}))
+    it = ListDataSetIterator(data, batch_size=48)
+    trainer.fit(it, epochs=60)
+    final = net.score(data.features, data.labels)
+    assert final < initial * 0.5
+    ev = Evaluation()
+    ev.eval(data.labels, np.asarray(net.output(data.features)))
+    assert ev.accuracy() > 0.85
+
+
+def test_dp_batch_padding():
+    net = MultiLayerNetwork(mlp_conf())
+    trainer = DataParallelTrainer(net, make_mesh({"data": 8}))
+    x = np.ones((10, 4), np.float32)
+    y = np.ones((10, 3), np.float32)
+    px, py = trainer.pad_batch(x, y)
+    assert px.shape[0] % 8 == 0 and px.shape[0] >= 10
+    # batch smaller than the pad amount must tile, not under-pad
+    px, py = trainer.pad_batch(x[:3], y[:3])
+    assert px.shape[0] == 8 and py.shape[0] == 8
