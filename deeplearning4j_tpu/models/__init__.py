@@ -1,2 +1,9 @@
 """Model families (RBM, autoencoders, LSTM, convolution) — importing this
 package registers their layer types in the layer registry."""
+
+from deeplearning4j_tpu.models.pretrain import (  # noqa: F401
+    RBM,
+    AutoEncoder,
+    RecursiveAutoEncoder,
+    binomial_corruption,
+)

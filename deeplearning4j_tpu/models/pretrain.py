@@ -1,0 +1,295 @@
+"""Unsupervised feature detectors: RBM, denoising AutoEncoder, recursive AE.
+
+Parity: reference core/models/featuredetectors/rbm/RBM.java (CD-k via Gibbs
+sampling with the 4 visible x 4 hidden unit-type matrix: contrastiveDivergence
+:105, gradient :114, sampleHiddenGivenVisible :240, gibbhVh :292, propUp :344,
+propDown :389, freeEnergy :222), autoencoder/AutoEncoder.java (encode :62,
+decode :79, gradient w/ binomial corruption :111), recursive/
+RecursiveAutoEncoder.java (sequence-fold reconstruction), and
+core/nn/layers/BasePretrainNetwork.java (getCorruptedInput :95,
+applySparsity :64).
+
+TPU-native design
+-----------------
+The reference hand-derives every gradient. Here each model exposes a single
+scalar `pretrain_loss(params, x, rng)` and the solver differentiates it with
+`jax.grad`, so the whole pretrain step fuses into one XLA program:
+
+* RBM: CD-k is not the gradient of any true loss, so we use the standard
+  surrogate-energy formulation: run the Gibbs chain OUTSIDE the gradient
+  (stop_gradient on every sample), then take
+  ``loss = mean_energy(v0, h0) - mean_energy(vk, hk)``.
+  d(loss)/dW = -(v0^T h0 - vk^T hk)/B — exactly the reference's
+  positive-minus-negative phase moments (RBM.java:169-186) for every
+  unit-type combination, because the bilinear energy is shared.
+* The Gibbs chain uses explicit PRNG keys (split per step); `k` is a config
+  constant so the chain unrolls into the jitted program.
+* Rectified hidden units use proper relu (the reference's
+  `Transforms.max(pre, 1.0)` at RBM.java:365 clamps at 1.0 — an alpha-era
+  bug we do not reproduce); gaussian means are `pre` (not the reference's
+  accidental `2*pre+noise` at RBM.java:370-372).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.layers import BaseLayer, register_layer
+from deeplearning4j_tpu.ops.activations import apply_activation
+from deeplearning4j_tpu.ops.losses import loss_fn
+
+
+def binomial_corruption(rng: jax.Array, x: jnp.ndarray,
+                        corruption_level: float) -> jnp.ndarray:
+    """Zero-mask each element with prob `corruption_level`
+    (reference BasePretrainNetwork.getCorruptedInput :95)."""
+    keep = jax.random.bernoulli(rng, 1.0 - corruption_level, x.shape)
+    return x * keep
+
+
+class BasePretrainLayer(BaseLayer):
+    """Shared machinery for {W, b(hidden), vb(visible)} energy/AE models
+    (reference BasePretrainNetwork + PretrainParamInitializer)."""
+
+    def param_shapes(self) -> Dict[str, tuple]:
+        c = self.conf
+        return {"W": (c.n_in, c.n_out), "b": (1, c.n_out), "vb": (1, c.n_in)}
+
+    # Subclasses implement: pretrain_loss(params, x, rng) -> scalar
+    def reconstruct(self, params, x):
+        raise NotImplementedError
+
+    def sparsity_penalty(self, hidden_mean):
+        """Pull mean hidden activation toward conf.sparsity (the reference's
+        applySparsity bias-gradient nudge, BasePretrainNetwork.java:64,
+        recast as a differentiable penalty)."""
+        c = self.conf
+        if c.sparsity == 0.0:
+            return 0.0
+        return jnp.sum(jnp.square(jnp.mean(hidden_mean, axis=0) - c.sparsity))
+
+
+@register_layer("rbm")
+class RBM(BasePretrainLayer):
+    """Restricted Boltzmann Machine with CD-k.
+
+    Unit types (conf.visible_unit x conf.hidden_unit), mirroring
+    RBM.java's VisibleUnit {BINARY, GAUSSIAN, SOFTMAX, LINEAR} and
+    HiddenUnit {BINARY, GAUSSIAN, SOFTMAX, RECTIFIED}.
+    """
+
+    # ------------------------------------------------------------ propagation
+    def prop_up(self, params, v):
+        """Hidden mean given visible (reference propUp :344)."""
+        pre = v @ params["W"] + params["b"]
+        h = self.conf.hidden_unit
+        if h == "binary":
+            return jax.nn.sigmoid(pre)
+        if h == "rectified":
+            return jax.nn.relu(pre)
+        if h == "gaussian":
+            return pre
+        if h == "softmax":
+            return jax.nn.softmax(pre, axis=-1)
+        raise ValueError(f"Unknown hidden unit {h!r}")
+
+    def prop_down(self, params, h):
+        """Visible mean given hidden (reference propDown :389)."""
+        pre = h @ params["W"].T + params["vb"]
+        v = self.conf.visible_unit
+        if v == "binary":
+            return jax.nn.sigmoid(pre)
+        if v in ("gaussian", "linear"):
+            return pre
+        if v == "softmax":
+            return jax.nn.softmax(pre, axis=-1)
+        raise ValueError(f"Unknown visible unit {v!r}")
+
+    # --------------------------------------------------------------- sampling
+    def sample_h_given_v(self, params, v, rng):
+        """(mean, sample) of hidden given visible
+        (reference sampleHiddenGivenVisible :240)."""
+        mean = self.prop_up(params, v)
+        h = self.conf.hidden_unit
+        if h == "binary":
+            sample = jax.random.bernoulli(rng, mean).astype(mean.dtype)
+        elif h == "rectified":
+            # NReLU: mean + N(0, sigmoid(mean)) clipped at 0
+            noise = jax.random.normal(rng, mean.shape, mean.dtype)
+            sample = jax.nn.relu(
+                mean + noise * jnp.sqrt(jax.nn.sigmoid(mean)))
+        elif h == "gaussian":
+            sample = mean + jax.random.normal(rng, mean.shape, mean.dtype)
+        else:  # softmax: reference uses the probs as the "sample"
+            sample = mean
+        return mean, sample
+
+    def sample_v_given_h(self, params, h, rng):
+        """(mean, sample) of visible given hidden
+        (reference sampleVisibleGivenHidden :309)."""
+        mean = self.prop_down(params, h)
+        v = self.conf.visible_unit
+        if v == "binary":
+            sample = jax.random.bernoulli(rng, mean).astype(mean.dtype)
+        elif v in ("gaussian", "linear"):
+            sample = mean + jax.random.normal(rng, mean.shape, mean.dtype)
+        else:  # softmax
+            sample = mean
+        return mean, sample
+
+    def gibbs_vhv(self, params, h, rng):
+        """One h -> v -> h Gibbs step (reference gibbhVh :292)."""
+        kv, kh = jax.random.split(rng)
+        v_mean, v_sample = self.sample_v_given_h(params, h, kv)
+        h_mean, h_sample = self.sample_h_given_v(params, v_sample, kh)
+        return (v_mean, v_sample), (h_mean, h_sample)
+
+    # ----------------------------------------------------------------- energy
+    def free_energy(self, params, v):
+        """-log sum_h exp(-E(v,h)) for binary hidden
+        (reference freeEnergy :222)."""
+        wx_b = v @ params["W"] + params["b"]
+        v_term = jnp.sum(v * params["vb"], axis=-1)
+        h_term = jnp.sum(jax.nn.softplus(wx_b), axis=-1)
+        return -h_term - v_term
+
+    def _mean_energy(self, params, v, h):
+        """Bilinear energy <E(v,h)> whose parameter-gradient reproduces the
+        CD moment statistics for every unit type."""
+        e = (jnp.sum(v * params["vb"], axis=-1)
+             + jnp.sum(h * params["b"], axis=-1)
+             + jnp.sum((v @ params["W"]) * h, axis=-1))
+        return -jnp.mean(e)
+
+    # ------------------------------------------------------------------- loss
+    def pretrain_loss(self, params, x, rng: jax.Array):
+        """CD-k surrogate loss (reference gradient() :114). The chain is
+        sampled with stop_gradient so jax.grad yields exactly
+        (negative-phase - positive-phase) moments."""
+        k = max(1, self.conf.k)
+        k0, *keys = jax.random.split(rng, k + 1)
+        h0_mean, h0_sample = self.sample_h_given_v(params, x, k0)
+        h = h0_sample
+        v_sample = x
+        for key in keys:  # k static -> unrolls into the XLA program
+            (_, v_sample), (h_mean, h) = self.gibbs_vhv(params, h, key)
+        sg = lax.stop_gradient
+        pos = self._mean_energy(params, x, sg(h0_mean))
+        neg = self._mean_energy(params, sg(v_sample), sg(h_mean))
+        loss = pos - neg
+        if self.conf.sparsity != 0.0:
+            loss = loss + self.sparsity_penalty(self.prop_up(params, x))
+        return loss
+
+    # -------------------------------------------------------------- inference
+    def reconstruct(self, params, x):
+        """propUp then propDown (reference transform :433)."""
+        return self.prop_down(params, self.prop_up(params, x))
+
+    def activate(self, params, x, *, rng: Optional[jax.Array] = None,
+                 training: bool = False):
+        """Forward activation inside a stacked net = hidden mean."""
+        act = self.prop_up(params, x)
+        c = self.conf
+        if training and c.dropout > 0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - c.dropout, act.shape)
+            act = act * keep / (1.0 - c.dropout)
+        return act
+
+
+@register_layer("autoencoder")
+class AutoEncoder(BasePretrainLayer):
+    """Denoising autoencoder with tied weights
+    (reference AutoEncoder.java: encode :62, decode :79, gradient :111)."""
+
+    def encode(self, params, x):
+        return apply_activation(self.conf.activation_function,
+                                x @ params["W"] + params["b"])
+
+    def decode(self, params, y):
+        return apply_activation(self.conf.activation_function,
+                                y @ params["W"].T + params["vb"])
+
+    def reconstruct(self, params, x):
+        return self.decode(params, self.encode(params, x))
+
+    def pretrain_loss(self, params, x, rng: jax.Array):
+        """Reconstruction loss of the corrupted input against the clean
+        input, via the configured loss function (reference gradient :111
+        hand-derives this for sigmoid+xent; autodiff covers all losses)."""
+        c = self.conf
+        corrupted = (binomial_corruption(rng, x, c.corruption_level)
+                     if c.corruption_level > 0 else x)
+        y = self.encode(params, corrupted)
+        z = self.decode(params, y)
+        loss = loss_fn(c.loss_function)(x, z)
+        return loss + self.sparsity_penalty(y)
+
+    def activate(self, params, x, *, rng: Optional[jax.Array] = None,
+                 training: bool = False):
+        act = self.encode(params, x)
+        c = self.conf
+        if training and c.dropout > 0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - c.dropout, act.shape)
+            act = act * keep / (1.0 - c.dropout)
+        return act
+
+
+@register_layer("recursive_autoencoder")
+class RecursiveAutoEncoder(BaseLayer):
+    """Recursive autoencoder folding a sequence of rows
+    (reference recursive/RecursiveAutoEncoder.java).
+
+    h_0 = x_0;  h_i = act([x_i ; h_{i-1}] @ W + c);
+    y_i = act(h_i @ U + bU) reconstructs [x_i ; h_{i-1}].
+    Loss = mean over steps of 0.5*||y_i - [x_i;h_{i-1}]||^2
+    (reference scoreSnapShot). Implemented as a lax.scan over the
+    sequence so the fold compiles to one XLA while-like program instead
+    of the reference's per-row Java loop.
+
+    Param names follow RecursiveParamInitializer: W/c encoder, U/bU decoder.
+    Hidden size == n_in so the recursion composes.
+    """
+
+    def param_shapes(self) -> Dict[str, tuple]:
+        n = self.conf.n_in
+        return {"W": (2 * n, n), "c": (1, n), "U": (n, 2 * n), "bU": (1, 2 * n)}
+
+    def _encode(self, params, combined):
+        return apply_activation(self.conf.activation_function,
+                                combined @ params["W"] + params["c"])
+
+    def _decode(self, params, hidden):
+        return apply_activation(self.conf.activation_function,
+                                hidden @ params["U"] + params["bU"])
+
+    def _fold(self, params, x):
+        """Scan the fold; x: (seq, n_in). Returns (final_hidden, total_loss)."""
+        if x.shape[0] < 2:
+            raise ValueError(
+                "RecursiveAutoEncoder needs a sequence of >= 2 rows to fold; "
+                f"got shape {x.shape}")
+
+        def step(h_prev, x_i):
+            combined = jnp.concatenate([x_i, h_prev], axis=-1)
+            h = self._encode(params, combined[None, :])[0]
+            y = self._decode(params, h[None, :])[0]
+            loss = 0.5 * jnp.mean(jnp.square(y - combined))
+            return h, (h, loss)
+
+        h_final, (hs, losses) = lax.scan(step, x[0], x[1:])
+        return h_final, jnp.mean(losses), hs
+
+    def pretrain_loss(self, params, x, rng: Optional[jax.Array] = None):
+        _, loss, _ = self._fold(params, x)
+        return loss
+
+    def activate(self, params, x, *, rng: Optional[jax.Array] = None,
+                 training: bool = False):
+        """Hidden representation at every fold step: (seq-1, n_in)."""
+        _, _, hs = self._fold(params, x)
+        return hs

@@ -36,6 +36,8 @@ def register_layer(name: str) -> Callable[[Type["BaseLayer"]], Type["BaseLayer"]
 
 def make_layer(conf) -> "BaseLayer":
     """Resolve conf.layer through the registry (LayerFactories parity)."""
+    if conf.layer.lower() not in LAYER_REGISTRY:
+        import deeplearning4j_tpu.models  # noqa: F401  registers model layers
     try:
         cls = LAYER_REGISTRY[conf.layer.lower()]
     except KeyError:
@@ -49,8 +51,16 @@ def make_layer(conf) -> "BaseLayer":
 class BaseLayer:
     """Dense affine + activation. Reference core/nn/layers/BaseLayer.java."""
 
+    #: parameter names initialized to zero (reference initializers zero all
+    #: bias-like variables: b, visible bias vb, recursive encoder bias c/bU)
+    BIAS_NAMES = ("b", "vb", "c", "bU", "bias")
+
     def __init__(self, conf):
         self.conf = conf
+
+    @classmethod
+    def is_bias(cls, name: str) -> bool:
+        return name in cls.BIAS_NAMES or name.startswith("b")
 
     # ------------------------------------------------------------- params
     def param_shapes(self) -> Dict[str, tuple]:
@@ -65,7 +75,7 @@ class BaseLayer:
         keys = jax.random.split(key, len(shapes))
         params = {}
         for (name, shape), k in zip(sorted(shapes.items()), keys):
-            if name.startswith("b"):
+            if self.is_bias(name):
                 params[name] = jnp.zeros(shape, jnp.dtype(c.dtype))
             else:
                 params[name] = init_weights(k, shape, c.weight_init, c.dist,

@@ -140,7 +140,7 @@ class MultiLayerNetwork:
             c = layer.conf
             if c.use_regularization and c.l2 > 0:
                 for name, value in params[str(i)].items():
-                    if not name.startswith("b"):
+                    if not layer.is_bias(name):
                         score = score + 0.5 * c.l2 * jnp.sum(jnp.square(value))
         return score
 
@@ -172,14 +172,14 @@ class MultiLayerNetwork:
                     cur = self.layers[j].activate(self._params[str(j)], cur)
                     cur = self._layer_output(j, cur)
                 cur = self._layer_input(i, cur)
-                rng = self.next_key()
                 flat0, unravel_i = ravel_pytree(self._params[str(i)])
 
-                def flat_loss(vec):
-                    return layer.pretrain_loss(unravel_i(vec), cur, rng)
+                def flat_loss(vec, key):
+                    return layer.pretrain_loss(unravel_i(vec), cur, key)
 
                 solver = Solver(layer.conf, flat_loss,
-                                listeners=self.listeners, model=self)
+                                listeners=self.listeners, model=self,
+                                rng_key=self.next_key())
                 new_params, score = solver.optimize(self._params[str(i)])
                 self._params[str(i)] = new_params
                 log.info("Pretrained layer %d (score=%s)", i, score)
@@ -232,14 +232,13 @@ class MultiLayerNetwork:
                                         float(score))
         else:
             flat0, unravel = ravel_pytree(self._params)
-            rng = self.next_key()
 
-            def flat_loss(vec):
-                return self.loss_fn(unravel(vec), x, labels, rng=rng,
+            def flat_loss(vec, key):
+                return self.loss_fn(unravel(vec), x, labels, rng=key,
                                     training=True)
 
             solver = Solver(conf0, flat_loss, listeners=self.listeners,
-                            model=self)
+                            model=self, rng_key=self.next_key())
             self._params, _ = solver.optimize(self._params)
 
     def _get_train_step(self):

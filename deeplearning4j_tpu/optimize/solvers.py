@@ -51,9 +51,9 @@ class BaseOptimizer:
         listeners: Optional[Sequence[IterationListener]] = None,
         terminations: Optional[Sequence[TerminationCondition]] = None,
         model=None,
+        rng_key: Optional[jax.Array] = None,
     ):
         self.conf = conf
-        self.loss = loss
         self.listeners: List[IterationListener] = list(listeners or [])
         self.terminations = list(
             terminations
@@ -61,9 +61,18 @@ class BaseOptimizer:
             else [EpsTermination(), ZeroDirection()]
         )
         self.model = model
-        self.value_and_grad = jax.jit(jax.value_and_grad(loss))
+        self.rng_key = rng_key
+        # Stochastic losses (CD Gibbs chains, denoising corruption, dropout)
+        # take (x, key) and get a FRESH key each iteration (fold_in of the
+        # iteration index); deterministic losses take (x,) and the key arg
+        # is ignored. The key is a traced argument so varying it never
+        # retriggers compilation.
+        if rng_key is not None:
+            self.loss = loss
+        else:
+            self.loss = lambda x, key: loss(x)
 
-    # subclasses: (x, state) -> (x, state, score, grad_norm)
+    # subclasses: (x, state, key) -> (x, state, score, grad_norm)
     def make_step(self):
         raise NotImplementedError
 
@@ -77,8 +86,11 @@ class BaseOptimizer:
         state = self.init_state(x)
         old_score = float("inf")
         score = None
+        base_key = (self.rng_key if self.rng_key is not None
+                    else jax.random.PRNGKey(0))
         for i in range(self.conf.num_iterations):
-            x, state, score_arr, gnorm_arr = step(x, state)
+            x, state, score_arr, gnorm_arr = step(
+                x, state, jax.random.fold_in(base_key, i))
             score, gnorm = float(score_arr), float(gnorm_arr)
             for listener in self.listeners:
                 listener.iteration_done(self.model, i, score)
@@ -102,8 +114,8 @@ class IterationGradientDescent(BaseOptimizer):
         sign = 1.0 if self.conf.minimize else -1.0
 
         @jax.jit
-        def step(x, state):
-            score, g = jax.value_and_grad(self.loss)(x)
+        def step(x, state, key):
+            score, g = jax.value_and_grad(self.loss)(x, key)
             updates, state = updater.update(g, state, x)
             return x - sign * updates, state, score, jnp.linalg.norm(g)
 
@@ -119,11 +131,12 @@ class GradientAscent(BaseOptimizer):
         max_iters = self.conf.num_line_search_iterations
 
         @jax.jit
-        def step(x, state):
-            score, g = jax.value_and_grad(self.loss)(x)
+        def step(x, state, key):
+            score, g = jax.value_and_grad(self.loss)(x, key)
             gnorm = jnp.linalg.norm(g)
             d = -g / (gnorm + 1e-12)
-            res = backtrack_line_search(self.loss, x, score, g, d,
+            res = backtrack_line_search(lambda xx: self.loss(xx, key),
+                                        x, score, g, d,
                                         initial_step=self.conf.lr,
                                         max_iterations=max_iters)
             return x + res.step * d, state, res.score, gnorm
@@ -141,9 +154,9 @@ class ConjugateGradient(BaseOptimizer):
         max_iters = self.conf.num_line_search_iterations
 
         @jax.jit
-        def step(x, state):
+        def step(x, state, key):
             g_prev, d_prev, first = state
-            score, g = jax.value_and_grad(self.loss)(x)
+            score, g = jax.value_and_grad(self.loss)(x, key)
             gnorm = jnp.linalg.norm(g)
             denom = jnp.vdot(g_prev, g_prev)
             beta = jnp.where(
@@ -155,7 +168,8 @@ class ConjugateGradient(BaseOptimizer):
             # Restart with steepest descent when d is not a descent direction
             descent = jnp.vdot(g, d) < 0
             d = jnp.where(descent, d, -g)
-            res = backtrack_line_search(self.loss, x, score, g,
+            res = backtrack_line_search(lambda xx: self.loss(xx, key),
+                                        x, score, g,
                                         d / (jnp.linalg.norm(d) + 1e-12),
                                         initial_step=1.0,
                                         max_iterations=max_iters)
@@ -194,9 +208,9 @@ class LBFGS(BaseOptimizer):
         max_ls = self.conf.num_line_search_iterations
 
         @jax.jit
-        def step(x, state):
+        def step(x, state, key):
             S, Y, rho, count, x_prev, g_prev = state
-            score, g = jax.value_and_grad(self.loss)(x)
+            score, g = jax.value_and_grad(self.loss)(x, key)
             gnorm = jnp.linalg.norm(g)
 
             # Update history with (s, y) from the last accepted step
@@ -238,7 +252,8 @@ class LBFGS(BaseOptimizer):
             d = -r
             descent = jnp.vdot(g, d) < 0
             d = jnp.where(descent, d, -g)
-            res = backtrack_line_search(self.loss, x, score, g, d,
+            res = backtrack_line_search(lambda xx: self.loss(xx, key),
+                                        x, score, g, d,
                                         initial_step=1.0,
                                         max_iterations=max_ls)
             new_x = x + res.step * d
@@ -276,18 +291,18 @@ class StochasticHessianFree(BaseOptimizer):
         cg_iters = self.cg_iterations
         user_matvec = self._user_matvec
 
-        def hvp(x, v):
+        def hvp(x, v, key):
             if user_matvec is not None:
                 return user_matvec(x, v)
-            return jax.jvp(jax.grad(loss), (x,), (v,))[1]
+            return jax.jvp(jax.grad(lambda xx: loss(xx, key)), (x,), (v,))[1]
 
         @jax.jit
-        def step(x, lam):
-            score, g = jax.value_and_grad(loss)(x)
+        def step(x, lam, key):
+            score, g = jax.value_and_grad(loss)(x, key)
             gnorm = jnp.linalg.norm(g)
 
             def Av(v):
-                return hvp(x, v) + lam * v
+                return hvp(x, v, key) + lam * v
 
             # Plain CG on A delta = -g (reference conjGradient :87)
             b = -g
@@ -308,7 +323,7 @@ class StochasticHessianFree(BaseOptimizer):
                                             (zeros, b, b))
 
             # Backtrack over the CG solution (reference cgBackTrack :184)
-            new_score = loss(x + delta)
+            new_score = loss(x + delta, key)
 
             def shrink_cond(s):
                 scale, ns, it = s
@@ -317,7 +332,7 @@ class StochasticHessianFree(BaseOptimizer):
             def shrink_body(s):
                 scale, _, it = s
                 scale = scale * 0.5
-                return (scale, loss(x + scale * delta), it + 1)
+                return (scale, loss(x + scale * delta, key), it + 1)
 
             scale, new_score, _ = jax.lax.while_loop(
                 shrink_cond, shrink_body,

@@ -1,0 +1,180 @@
+"""Pretrain model tests (reference RBMTests.java, AutoEncoderTest.java,
+RecursiveAutoEncoderTest.java: CD-k lowers reconstruction error on tiny
+binary data; DBN pretrain+finetune end-to-end on Iris)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.models.pretrain import (
+    RBM, AutoEncoder, RecursiveAutoEncoder, binomial_corruption)
+from deeplearning4j_tpu.nn.layers import make_layer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.datasets.iris import load_iris
+from deeplearning4j_tpu.eval import Evaluation
+
+
+def tiny_binary_data():
+    # The classic 6x6 two-cluster pattern used by the reference RBMTests
+    return jnp.array([
+        [1, 1, 1, 0, 0, 0],
+        [1, 0, 1, 0, 0, 0],
+        [1, 1, 1, 0, 0, 0],
+        [0, 0, 1, 1, 1, 0],
+        [0, 0, 1, 1, 0, 0],
+        [0, 0, 1, 1, 1, 0],
+    ], jnp.float32)
+
+
+def layer_conf(**kw):
+    defaults = dict(layer="rbm", n_in=6, n_out=4, lr=0.1,
+                    num_iterations=50, use_adagrad=False, momentum=0.0,
+                    optimization_algo="iteration_gradient_descent")
+    defaults.update(kw)
+    c = NeuralNetConfiguration()
+    for k, v in defaults.items():
+        setattr(c, k, v)
+    return c
+
+
+def sgd_pretrain(layer, x, steps=200, lr=0.1, seed=0):
+    params = layer.init_params(jax.random.PRNGKey(seed))
+    key = jax.random.PRNGKey(seed + 1)
+    grad_fn = jax.jit(jax.grad(layer.pretrain_loss))
+    for _ in range(steps):
+        key, sub = jax.random.split(key)
+        grads = grad_fn(params, x, sub)
+        params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+    return params
+
+
+def recon_error(layer, params, x):
+    return float(jnp.mean(jnp.square(layer.reconstruct(params, x) - x)))
+
+
+class TestRBM:
+    def test_param_shapes(self):
+        layer = make_layer(layer_conf())
+        params = layer.init_params(jax.random.PRNGKey(0))
+        assert params["W"].shape == (6, 4)
+        assert params["b"].shape == (1, 4)
+        assert params["vb"].shape == (1, 6)
+
+    def test_cd_gradient_moments(self):
+        """grad_W of the surrogate loss == -(v0'h0 - vk'hk)/B."""
+        layer = RBM(layer_conf(k=1))
+        x = tiny_binary_data()
+        params = layer.init_params(jax.random.PRNGKey(0))
+        rng = jax.random.PRNGKey(42)
+        grads = jax.grad(layer.pretrain_loss)(params, x, rng)
+
+        # Recompute the chain with the same keys to check the moments
+        k0, k1 = jax.random.split(rng, 2)
+        h0_mean, h0_sample = layer.sample_h_given_v(params, x, k0)
+        (_, vk), (hk_mean, _) = layer.gibbs_vhv(params, h0_sample, k1)
+        b = x.shape[0]
+        expected_w = -(x.T @ h0_mean - vk.T @ hk_mean) / b
+        np.testing.assert_allclose(np.asarray(grads["W"]),
+                                   np.asarray(expected_w), rtol=1e-5)
+
+    def test_cd_training_lowers_reconstruction_error(self):
+        layer = RBM(layer_conf(k=1))
+        x = tiny_binary_data()
+        params0 = layer.init_params(jax.random.PRNGKey(0))
+        err0 = recon_error(layer, params0, x)
+        params = sgd_pretrain(layer, x, steps=300, lr=0.5)
+        assert recon_error(layer, params, x) < err0
+
+    @pytest.mark.parametrize("visible,hidden", [
+        ("binary", "binary"), ("gaussian", "rectified"),
+        ("binary", "softmax"), ("linear", "gaussian"),
+        ("softmax", "binary"),
+    ])
+    def test_unit_type_combinations_run(self, visible, hidden):
+        layer = RBM(layer_conf(visible_unit=visible, hidden_unit=hidden, k=2))
+        x = tiny_binary_data()
+        params = layer.init_params(jax.random.PRNGKey(0))
+        loss = layer.pretrain_loss(params, x, jax.random.PRNGKey(1))
+        assert np.isfinite(float(loss))
+        grads = jax.grad(layer.pretrain_loss)(params, x, jax.random.PRNGKey(1))
+        for g in jax.tree_util.tree_leaves(grads):
+            assert np.all(np.isfinite(np.asarray(g)))
+
+    def test_free_energy_finite_and_lower_for_training_data(self):
+        layer = RBM(layer_conf(k=1))
+        x = tiny_binary_data()
+        params = sgd_pretrain(layer, x, steps=300, lr=0.5)
+        fe_data = float(jnp.mean(layer.free_energy(params, x)))
+        noise = jax.random.bernoulli(
+            jax.random.PRNGKey(9), 0.5, x.shape).astype(jnp.float32)
+        fe_noise = float(jnp.mean(layer.free_energy(params, noise)))
+        assert np.isfinite(fe_data) and np.isfinite(fe_noise)
+        assert fe_data < fe_noise
+
+
+class TestAutoEncoder:
+    def test_corruption_masks_elements(self):
+        x = jnp.ones((8, 10))
+        corrupted = binomial_corruption(jax.random.PRNGKey(0), x, 0.5)
+        frac = float(jnp.mean(corrupted))
+        assert 0.2 < frac < 0.8
+        assert set(np.unique(np.asarray(corrupted))) <= {0.0, 1.0}
+
+    def test_denoising_ae_lowers_reconstruction_error(self):
+        layer = AutoEncoder(layer_conf(
+            layer="autoencoder", corruption_level=0.3,
+            loss_function="reconstruction_crossentropy"))
+        x = tiny_binary_data()
+        params0 = layer.init_params(jax.random.PRNGKey(0))
+        err0 = recon_error(layer, params0, x)
+        params = sgd_pretrain(layer, x, steps=300, lr=0.5)
+        assert recon_error(layer, params, x) < err0
+
+    def test_encode_decode_shapes(self):
+        layer = AutoEncoder(layer_conf(layer="autoencoder"))
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = tiny_binary_data()
+        y = layer.encode(params, x)
+        assert y.shape == (6, 4)
+        z = layer.decode(params, y)
+        assert z.shape == (6, 6)
+
+
+class TestRecursiveAutoEncoder:
+    def test_fold_shapes_and_training(self):
+        conf = layer_conf(layer="recursive_autoencoder", n_in=5, n_out=5,
+                          activation_function="tanh")
+        layer = RecursiveAutoEncoder(conf)
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (7, 5))
+        hs = layer.activate(params, x)
+        assert hs.shape == (6, 5)
+        loss0 = float(layer.pretrain_loss(params, x))
+        params = sgd_pretrain(layer, x, steps=100, lr=0.05)
+        assert float(layer.pretrain_loss(params, x)) < loss0
+
+
+class TestDBNEndToEnd:
+    def test_dbn_pretrain_finetune_iris(self):
+        """Reference MultiLayerTest.java: DBN (RBM stack) on Iris with
+        pretrain + finetune reaches decent f1."""
+        x, y = load_iris()
+        conf = (NeuralNetConfiguration.builder()
+                .lr(0.1).n_in(4).activation_function("sigmoid")
+                .optimization_algo("iteration_gradient_descent")
+                .num_iterations(30)
+                .use_adagrad(False)
+                .list(2)
+                .hidden_layer_sizes([12])
+                .override(0, layer="rbm", k=1)
+                .override(1, layer="output", loss_function="mcxent",
+                          activation_function="softmax", n_out=3)
+                .pretrain(True)
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.fit(x, y, epochs=20)
+        ev = Evaluation()
+        ev.eval(y, np.asarray(net.output(x)))
+        assert ev.f1() > 0.7
