@@ -7,3 +7,5 @@ from deeplearning4j_tpu.models.pretrain import (  # noqa: F401
     RecursiveAutoEncoder,
     binomial_corruption,
 )
+from deeplearning4j_tpu.models.conv import ConvolutionDownSampleLayer  # noqa: F401
+from deeplearning4j_tpu.models.lstm import LSTM  # noqa: F401

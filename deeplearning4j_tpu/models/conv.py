@@ -1,0 +1,95 @@
+"""Convolution + max-pool layer.
+
+Parity: reference core/nn/layers/convolution/ConvolutionDownSampleLayer.java:52-88
+(conv2d VALID -> maxPool(stride) -> broadcast per-feature-map bias ->
+activation) with params named by ConvolutionParamInitializer
+("convweights"/"convbias", core/nn/params/ConvolutionParamInitializer.java:33-44).
+
+TPU-native design: NHWC layout with HWIO filters so XLA tiles the conv onto
+the MXU (channels on lanes); `lax.reduce_window` for the max-pool; and —
+unlike the reference, whose `gradient()` returns null (conv training was
+incomplete, ConvolutionDownSampleLayer.java:95) — the layer is fully
+trainable end-to-end via autodiff. The conv runs in conf.compute_dtype
+(bfloat16 on the MXU when configured) accumulating in float32.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.layers import (BaseLayer, apply_dropout,
+                                          register_layer)
+from deeplearning4j_tpu.ops.activations import apply_activation
+from deeplearning4j_tpu.ops.initializers import init_weights
+
+
+@register_layer("conv")
+class ConvolutionDownSampleLayer(BaseLayer):
+    """conv2d (VALID) + max-pool + bias + activation, NHWC.
+
+    Config: `filter_size=[fh, fw]`, `num_in_feature_maps` (C_in),
+    `num_feature_maps` (C_out), `stride=[sh, sw]` (pool window AND stride,
+    matching the reference's Transforms.maxPool semantics).
+    """
+
+    def _filter_hw(self):
+        fs = self.conf.filter_size or [2, 2]
+        return int(fs[0]), int(fs[1])
+
+    def _pool_hw(self):
+        st = self.conf.stride or [2, 2]
+        return int(st[0]), int(st[1])
+
+    def param_shapes(self) -> Dict[str, tuple]:
+        c = self.conf
+        fh, fw = self._filter_hw()
+        # HWIO filters ("convweights"); one bias per output feature map
+        return {"W": (fh, fw, c.num_in_feature_maps, c.num_feature_maps),
+                "b": (c.num_feature_maps,)}
+
+    def init_params(self, key: jax.Array):
+        c = self.conf
+        shapes = self.param_shapes()
+        params = {"b": jnp.zeros(shapes["b"], jnp.dtype(c.dtype)),
+                  "W": init_weights(key, shapes["W"], c.weight_init, c.dist,
+                                    jnp.dtype(c.dtype))}
+        c.variable("W")
+        c.variable("b")
+        return params
+
+    def activate(self, params, x, *, rng: Optional[jax.Array] = None,
+                 training: bool = False):
+        c = self.conf
+        fh, fw = self._filter_hw()
+        if x.ndim != 4:
+            raise ValueError(f"conv input must be NHWC, got shape {x.shape}")
+        if x.shape[3] != c.num_in_feature_maps:
+            # reference ConvolutionDownSampleLayer.activate:54 throws here too
+            raise ValueError(
+                f"Input feature maps {x.shape[3]} != configured "
+                f"num_in_feature_maps {c.num_in_feature_maps}")
+        if x.shape[1] < fh or x.shape[2] < fw:
+            raise ValueError(
+                f"Filter {fh}x{fw} larger than input {x.shape[1]}x{x.shape[2]}")
+        cd = jnp.dtype(c.compute_dtype)
+        conv = lax.conv_general_dilated(
+            x.astype(cd), params["W"].astype(cd),
+            window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.dtype(c.dtype))
+        ph, pw = self._pool_hw()
+        pooled = lax.reduce_window(
+            conv, -jnp.inf, lax.max,
+            window_dimensions=(1, ph, pw, 1),
+            window_strides=(1, ph, pw, 1),
+            padding="VALID")
+        act = apply_activation(c.activation_function, pooled + params["b"])
+        return apply_dropout(rng, act, c.dropout, training)
+
+
+register_layer("convolution")(ConvolutionDownSampleLayer)

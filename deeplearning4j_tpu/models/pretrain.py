@@ -38,7 +38,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from deeplearning4j_tpu.nn.layers import BaseLayer, register_layer
+from deeplearning4j_tpu.nn.layers import (BaseLayer, apply_dropout,
+                                          register_layer)
 from deeplearning4j_tpu.ops.activations import apply_activation
 from deeplearning4j_tpu.ops.losses import loss_fn
 
@@ -194,11 +195,7 @@ class RBM(BasePretrainLayer):
                  training: bool = False):
         """Forward activation inside a stacked net = hidden mean."""
         act = self.prop_up(params, x)
-        c = self.conf
-        if training and c.dropout > 0 and rng is not None:
-            keep = jax.random.bernoulli(rng, 1.0 - c.dropout, act.shape)
-            act = act * keep / (1.0 - c.dropout)
-        return act
+        return apply_dropout(rng, act, self.conf.dropout, training)
 
 
 @register_layer("autoencoder")
@@ -232,11 +229,7 @@ class AutoEncoder(BasePretrainLayer):
     def activate(self, params, x, *, rng: Optional[jax.Array] = None,
                  training: bool = False):
         act = self.encode(params, x)
-        c = self.conf
-        if training and c.dropout > 0 and rng is not None:
-            keep = jax.random.bernoulli(rng, 1.0 - c.dropout, act.shape)
-            act = act * keep / (1.0 - c.dropout)
-        return act
+        return apply_dropout(rng, act, self.conf.dropout, training)
 
 
 @register_layer("recursive_autoencoder")

@@ -34,6 +34,16 @@ def register_layer(name: str) -> Callable[[Type["BaseLayer"]], Type["BaseLayer"]
     return deco
 
 
+def apply_dropout(rng: Optional[jax.Array], x, rate: float,
+                  training: bool = True):
+    """Inverted dropout: keep-mask + 1/(1-rate) scale. No-op when not
+    training, rate == 0, or no key is provided (inference = expectation)."""
+    if not training or rate <= 0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return x * keep / (1.0 - rate)
+
+
 def make_layer(conf) -> "BaseLayer":
     """Resolve conf.layer through the registry (LayerFactories parity)."""
     if conf.layer.lower() not in LAYER_REGISTRY:
@@ -111,10 +121,8 @@ class BaseLayer:
         act = apply_activation(c.activation_function,
                                self.pre_output(params, x, rng=pre_rng,
                                                training=training))
-        if training and c.dropout > 0 and not c.use_drop_connect \
-                and drop_rng is not None:
-            keep = jax.random.bernoulli(drop_rng, 1.0 - c.dropout, act.shape)
-            act = act * keep / (1.0 - c.dropout)
+        if not c.use_drop_connect:
+            act = apply_dropout(drop_rng, act, c.dropout, training)
         return act
 
     __call__ = activate

@@ -58,9 +58,11 @@ class BinomialSamplingPreProcessor(PreProcessor):
 
 @register_preprocessor("conv_input")
 class ConvolutionInputPreProcessor(PreProcessor):
-    """Flat (B, rows*cols) -> NCHW (B, channels, rows, cols) for conv layers.
+    """Flat (B, rows*cols*channels) -> NHWC (B, rows, cols, channels).
 
-    Parity: reference ConvolutionInputPreProcessor.java.
+    Parity: reference ConvolutionInputPreProcessor.java (which targets NCHW);
+    here the layout is NHWC — the native layout for TPU convolutions, where
+    the channel dimension maps onto the MXU lanes.
     """
 
     def __init__(self, rows: int, cols: int, channels: int = 1):
@@ -70,12 +72,12 @@ class ConvolutionInputPreProcessor(PreProcessor):
         return {"rows": self.rows, "cols": self.cols, "channels": self.channels}
 
     def __call__(self, x, *, rng=None):
-        return jnp.reshape(x, (x.shape[0], self.channels, self.rows, self.cols))
+        return jnp.reshape(x, (x.shape[0], self.rows, self.cols, self.channels))
 
 
 @register_preprocessor("conv_output")
 class ConvolutionPostProcessor(PreProcessor):
-    """NCHW -> flat (B, C*H*W) after a conv stack (ConvolutionPostProcessor.java)."""
+    """NHWC -> flat (B, H*W*C) after a conv stack (ConvolutionPostProcessor.java)."""
 
     def __call__(self, x, *, rng=None):
         return jnp.reshape(x, (x.shape[0], -1))

@@ -26,9 +26,9 @@ class WeightInit:
 def _fans(shape: Tuple[int, ...]) -> Tuple[int, int]:
     if len(shape) == 2:
         return shape[0], shape[1]
-    if len(shape) == 4:  # conv OIHW
-        receptive = shape[2] * shape[3]
-        return shape[1] * receptive, shape[0] * receptive
+    if len(shape) == 4:  # conv HWIO (the framework-wide TPU filter layout)
+        receptive = shape[0] * shape[1]
+        return shape[2] * receptive, shape[3] * receptive
     n = int(jnp.prod(jnp.array(shape)))
     return n, n
 

@@ -1,0 +1,152 @@
+"""Conv + LSTM tests (reference ConvolutionDownSampleLayerTest.java /
+LSTMTest.java — plus full conv training, which the reference never had)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.models.conv import ConvolutionDownSampleLayer
+from deeplearning4j_tpu.models.lstm import LSTM
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.preprocessors import (
+    ConvolutionInputPreProcessor, ConvolutionPostProcessor)
+from deeplearning4j_tpu.datasets.mnist import synthetic_mnist
+
+
+def conv_conf(**kw):
+    c = NeuralNetConfiguration()
+    c.layer = "conv"
+    c.filter_size = [5, 5]
+    c.stride = [2, 2]
+    c.num_in_feature_maps = 1
+    c.num_feature_maps = 6
+    c.activation_function = "relu"
+    for k, v in kw.items():
+        setattr(c, k, v)
+    return c
+
+
+class TestConvLayer:
+    def test_forward_shapes(self):
+        layer = ConvolutionDownSampleLayer(conv_conf())
+        params = layer.init_params(jax.random.PRNGKey(0))
+        assert params["W"].shape == (5, 5, 1, 6)
+        x = jnp.ones((4, 28, 28, 1))
+        out = layer.activate(params, x)
+        # 28 -5+1 = 24 conv; pool 2x2 stride 2 -> 12
+        assert out.shape == (4, 12, 12, 6)
+
+    def test_gradient_flows(self):
+        """Unlike the reference (gradient() == null), conv training works."""
+        layer = ConvolutionDownSampleLayer(conv_conf())
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 28, 28, 1))
+
+        def loss(p):
+            return jnp.mean(jnp.square(layer.activate(p, x)))
+
+        grads = jax.grad(loss)(params)
+        assert float(jnp.linalg.norm(grads["W"])) > 0
+        assert np.all(np.isfinite(np.asarray(grads["W"])))
+
+
+def lenet_conf(lr=0.05, iters=3):
+    """LeNet-5-style config on 28x28 MNIST (BASELINE config 2)."""
+    return (NeuralNetConfiguration.builder()
+            .lr(lr).activation_function("relu")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(iters).use_adagrad(False)
+            .list(4)
+            .override(0, layer="conv", filter_size=[5, 5], stride=[2, 2],
+                      num_in_feature_maps=1, num_feature_maps=6)
+            .override(1, layer="conv", filter_size=[5, 5], stride=[2, 2],
+                      num_in_feature_maps=6, num_feature_maps=16)
+            .override(2, layer="dense", n_in=4 * 4 * 16, n_out=120)
+            .override(3, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_in=120, n_out=10)
+            .input_preprocessor(0, ConvolutionInputPreProcessor(28, 28, 1))
+            .input_preprocessor(2, ConvolutionPostProcessor())
+            .pretrain(False)
+            .build())
+
+
+class TestLeNet:
+    def test_lenet_mnist_trains(self):
+        x, y = synthetic_mnist(64)
+        net = MultiLayerNetwork(lenet_conf())
+        s0 = net.score(x, y)
+        net.fit(x, y, epochs=8)
+        s1 = net.score(x, y)
+        assert s1 < s0
+        assert net.output(x).shape == (64, 10)
+
+    def test_lenet_json_round_trip(self):
+        net = MultiLayerNetwork(lenet_conf())
+        js = net.to_json()
+        net2 = MultiLayerNetwork.from_config_json(js, params=net.params())
+        x, y = synthetic_mnist(8)
+        np.testing.assert_allclose(np.asarray(net.output(x)),
+                                   np.asarray(net2.output(x)), atol=1e-5)
+
+
+def lstm_conf(n_in=8, n_out=8, **kw):
+    c = NeuralNetConfiguration()
+    c.layer = "lstm"
+    c.n_in = n_in
+    c.n_out = n_out
+    c.activation_function = "tanh"
+    c.loss_function = "mcxent"
+    for k, v in kw.items():
+        setattr(c, k, v)
+    return c
+
+
+class TestLSTM:
+    def test_shapes(self):
+        layer = LSTM(lstm_conf())
+        params = layer.init_params(jax.random.PRNGKey(0))
+        assert params["R"].shape == (1 + 16, 32)
+        x = jax.random.normal(jax.random.PRNGKey(1), (10, 8))
+        out = layer.activate(params, x)
+        assert out.shape == (10, 8)
+        batched = layer.activate(params, x[None].repeat(3, axis=0))
+        assert batched.shape == (3, 10, 8)
+
+    def test_learns_next_token(self):
+        """Char-RNN style: learn to predict the next one-hot token of a
+        repeating pattern (reference LSTMTest trains on 'hello world')."""
+        pattern = [0, 1, 2, 3, 2, 1] * 6
+        x = jnp.eye(8)[jnp.asarray(pattern[:-1])]
+        y = jnp.eye(8)[jnp.asarray(pattern[1:])]
+        layer = LSTM(lstm_conf())
+        params = layer.init_params(jax.random.PRNGKey(0))
+        loss0 = float(layer.loss(params, x, y))
+        grad_fn = jax.jit(jax.grad(layer.loss))
+        for _ in range(150):
+            g = grad_fn(params, x, y)
+            params = jax.tree_util.tree_map(lambda p, gg: p - 0.5 * gg,
+                                            params, g)
+        loss1 = float(layer.loss(params, x, y))
+        assert loss1 < loss0 * 0.5
+        preds = np.argmax(np.asarray(layer.activate(params, x)), axis=-1)
+        assert (preds[5:] == np.asarray(pattern[6:])).mean() > 0.8
+
+    def test_beam_search_decodes(self):
+        layer = LSTM(lstm_conf())
+        params = layer.init_params(jax.random.PRNGKey(0))
+        ws = jnp.eye(8)
+        results = layer.predict(params, ws[1], ws, beam_size=3, n_steps=5)
+        assert len(results) == 3
+        seq, logp = results[0]
+        assert len(seq) >= 1 and all(0 <= t < 8 for t in seq)
+        assert logp <= 0
+        # best-first ordering
+        assert all(results[i][1] >= results[i + 1][1]
+                   for i in range(len(results) - 1))
+
+    def test_in_multilayer_network(self):
+        """LSTM registered in the layer registry resolves via make_layer."""
+        from deeplearning4j_tpu.nn.layers import make_layer
+        layer = make_layer(lstm_conf())
+        assert isinstance(layer, LSTM)
