@@ -26,7 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from deeplearning4j_tpu.nn.layers import BaseLayer, register_layer
+from deeplearning4j_tpu.nn.layers import (BaseLayer, apply_dropout,
+                                          register_layer)
 from deeplearning4j_tpu.ops.initializers import init_weights
 from deeplearning4j_tpu.ops.losses import loss_fn
 
@@ -82,10 +83,7 @@ class LSTM(BaseLayer):
     def _scan_sequence(self, params, x, rng=None, training=False):
         """x: (T, n_in) -> hidden sequence (T, d) via lax.scan."""
         d, _ = self._dims()
-        c = self.conf
-        if training and c.dropout > 0 and rng is not None:
-            keep = jax.random.bernoulli(rng, 1.0 - c.dropout, x.shape)
-            x = x * keep / (1.0 - c.dropout)
+        x = apply_dropout(rng, x, self.conf.dropout, training)
 
         def step(carry, x_t):
             h_prev, c_prev = carry

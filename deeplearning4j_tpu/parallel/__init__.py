@@ -2,3 +2,6 @@ from deeplearning4j_tpu.parallel.mesh import make_mesh  # noqa: F401
 from deeplearning4j_tpu.parallel.data_parallel import (  # noqa: F401
     DataParallelTrainer,
 )
+from deeplearning4j_tpu.parallel.averaging import (  # noqa: F401
+    ParameterAveragingTrainer,
+)

@@ -95,5 +95,6 @@ class DataParallelTrainer:
                     steps += 1
         net._params = params
         net._updater_state = upd_state
-        for listener in net.listeners:
-            listener.iteration_done(net, steps - 1, float(score))
+        if steps:
+            for listener in net.listeners:
+                listener.iteration_done(net, steps - 1, float(score))

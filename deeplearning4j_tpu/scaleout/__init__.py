@@ -1,0 +1,44 @@
+"""Scaleout: the distributed-training contract and runtimes.
+
+Parity: reference deeplearning4j-scaleout — the scaleout API
+(…/scaleout/job/Job.java, perform/WorkerPerformer.java,
+aggregator/JobAggregator.java, api/statetracker/StateTracker.java,
+api/workrouter/WorkRouter.java), the Akka runtime (MasterActor/WorkerActor/
+BatchActor heartbeat choreography), and the Spark/YARN iterative-reduce
+variants — all of which implement data-parallel parameter averaging.
+
+TPU-native design: the DATA plane (parameter exchange) belongs on the chips
+— `parallel.DataParallelTrainer` (per-step psum over ICI) and
+`parallel.ParameterAveragingTrainer` (epoch-wave pmean, behavioral parity
+with MultiLayerNetwork.merge). The scaleout package is the HOST-side control
+plane the reference built actors/Hazelcast for: job routing, worker registry,
+heartbeats/eviction, update accumulation, counters, early-stop state, and
+checkpointing — runnable fully in-process (the reference's
+BaseTestDistributed / IRUnit tier) and designed so a multi-host deployment
+swaps the in-memory tracker for one backed by jax.distributed's
+coordination service.
+"""
+
+from deeplearning4j_tpu.scaleout.api import (  # noqa: F401
+    CollectionJobIterator,
+    DataSetJobIterator,
+    HogWildWorkRouter,
+    IterativeReduceWorkRouter,
+    Job,
+    JobAggregator,
+    JobIterator,
+    LocalFileUpdateSaver,
+    InMemoryUpdateSaver,
+    WorkerPerformer,
+    WorkRouter,
+)
+from deeplearning4j_tpu.scaleout.statetracker import InMemoryStateTracker  # noqa: F401
+from deeplearning4j_tpu.scaleout.aggregator import (  # noqa: F401
+    ParameterAveragingAggregator,
+)
+from deeplearning4j_tpu.scaleout.perform import NeuralNetWorkPerformer  # noqa: F401
+from deeplearning4j_tpu.scaleout.runtime import DistributedRuntime  # noqa: F401
+from deeplearning4j_tpu.scaleout.checkpoint import (  # noqa: F401
+    DefaultModelSaver,
+    load_checkpoint,
+)

@@ -1,0 +1,273 @@
+"""The scaleout API: the distributable-work contract.
+
+Parity: reference deeplearning4j-scaleout-api (SURVEY §2.2) —
+`Job` (…/scaleout/job/Job.java:24: {work, result, workerId}),
+`JobIterator`/`JobIteratorFactory` (…/scaleout/job/),
+`WorkerPerformer` (…/scaleout/perform/WorkerPerformer.java:
+perform/update/setup), `JobAggregator` (…/scaleout/aggregator/),
+`WorkRouter`/`BaseWorkRouter` (…/api/workrouter/: sendWork gate + routeJob),
+`UpdateSaver` (…/api/statetracker/UpdateSaver.java: off-heap persistence of
+pending updates).
+
+These are deliberately plain-Python host-side objects: on TPU the heavy
+parameter exchange rides XLA collectives (parallel/), so the scaleout layer
+only moves small control records and (for parameter-averaging parity mode)
+packed parameter vectors between host threads/processes.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class Job:
+    """Unit of distributable work (reference Job.java:24)."""
+
+    work: Any
+    worker_id: str
+    result: Any = None
+    retries: int = 0
+
+    def __repr__(self):
+        return (f"Job(worker_id={self.worker_id!r}, "
+                f"has_result={self.result is not None})")
+
+
+class JobIterator:
+    """Stream of Jobs bound to worker ids (reference JobIterator)."""
+
+    def next(self, worker_id: str) -> Job:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+
+class CollectionJobIterator(JobIterator):
+    """Iterate a fixed collection of work items
+    (reference CollectionJobIterator)."""
+
+    def __init__(self, items: List[Any]):
+        self.items = list(items)
+        self._pos = 0
+        self._lock = threading.Lock()
+
+    def next(self, worker_id: str) -> Job:
+        with self._lock:
+            if self._pos >= len(self.items):
+                raise StopIteration
+            item = self.items[self._pos]
+            self._pos += 1
+        return Job(work=item, worker_id=worker_id)
+
+    def has_next(self) -> bool:
+        with self._lock:
+            return self._pos < len(self.items)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._pos = 0
+
+
+class DataSetJobIterator(JobIterator):
+    """Wrap a DataSetIterator as a stream of mini-batch jobs (the reference's
+    BatchActor pattern: each wave hands the next mini-batch to a worker,
+    akka BatchActor.java:72-160)."""
+
+    def __init__(self, dataset_iterator):
+        self.it = dataset_iterator
+        self._iter: Optional[Iterator] = None
+        self._pending: Optional[Any] = None
+        self._lock = threading.Lock()
+
+    def _ensure(self):
+        if self._iter is None:
+            self.it.reset()
+            self._iter = iter(self.it)
+
+    def next(self, worker_id: str) -> Job:
+        with self._lock:
+            self._ensure()
+            if self._pending is not None:
+                ds, self._pending = self._pending, None
+            else:
+                ds = next(self._iter)
+            return Job(work=ds, worker_id=worker_id)
+
+    def has_next(self) -> bool:
+        with self._lock:
+            self._ensure()
+            if self._pending is not None:
+                return True
+            try:
+                self._pending = next(self._iter)
+                return True
+            except StopIteration:
+                return False
+
+    def reset(self) -> None:
+        with self._lock:
+            self.it.reset()
+            self._iter = iter(self.it)
+            self._pending = None
+
+
+class WorkerPerformer:
+    """Pluggable compute (reference WorkerPerformer.java): `perform(job)`
+    fills job.result; `update(*args)` installs new global state;
+    `setup(conf)` wires from a config dict."""
+
+    def perform(self, job: Job) -> None:
+        raise NotImplementedError
+
+    def update(self, *args: Any) -> None:
+        raise NotImplementedError
+
+    def setup(self, conf: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+
+class JobAggregator:
+    """Reduce worker results (reference JobAggregator/WorkAccumulator)."""
+
+    def accumulate(self, job: Job) -> None:
+        raise NotImplementedError
+
+    def aggregate(self) -> Any:
+        raise NotImplementedError
+
+
+class WorkRouter:
+    """Policy for when/where work is sent (reference WorkRouter/
+    BaseWorkRouter: sendWork gate + routeJob)."""
+
+    WORK_ROUTER = "work_router"  # config key parity
+
+    def __init__(self, state_tracker):
+        self.tracker = state_tracker
+
+    def send_work(self) -> bool:
+        raise NotImplementedError
+
+    def route_job(self, job: Job) -> None:
+        self.tracker.add_job(job)
+
+
+class IterativeReduceWorkRouter(WorkRouter):
+    """Synchronous DP: dispatch the next wave only when every registered
+    worker has reported its update (reference
+    IterativeReduceWorkRouter.java:46-57)."""
+
+    def send_work(self) -> bool:
+        workers = self.tracker.workers()
+        if not workers:
+            return False
+        return len(self.tracker.worker_updates()) >= len(workers)
+
+
+class HogWildWorkRouter(WorkRouter):
+    """Asynchronous DP: always send — lock-free hogwild-style updates
+    (reference HogWildWorkRouter.java:44-47)."""
+
+    def send_work(self) -> bool:
+        return True
+
+
+class UpdateSaver:
+    """Persistence for pending updates (reference UpdateSaver.java)."""
+
+    def save(self, worker_id: str, update: Any) -> None:
+        raise NotImplementedError
+
+    def load(self, worker_id: str) -> Any:
+        raise NotImplementedError
+
+    def keys(self) -> List[str]:
+        raise NotImplementedError
+
+    def delete(self, worker_id: str) -> None:
+        raise NotImplementedError
+
+    def clear(self) -> None:
+        raise NotImplementedError
+
+
+class InMemoryUpdateSaver(UpdateSaver):
+    def __init__(self):
+        self._updates: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def save(self, worker_id, update):
+        with self._lock:
+            self._updates[worker_id] = update
+
+    def load(self, worker_id):
+        with self._lock:
+            return self._updates.get(worker_id)
+
+    def keys(self):
+        with self._lock:
+            return list(self._updates)
+
+    def delete(self, worker_id):
+        with self._lock:
+            self._updates.pop(worker_id, None)
+
+    def clear(self):
+        with self._lock:
+            self._updates.clear()
+
+
+class LocalFileUpdateSaver(UpdateSaver):
+    """Spill worker updates to local files keyed by worker id — updates
+    accumulate on disk, not RAM (reference LocalFileUpdateSaver.java:36-120)."""
+
+    def __init__(self, directory: Optional[str] = None):
+        self.dir = directory or tempfile.mkdtemp(prefix="dl4j_tpu_updates_")
+        os.makedirs(self.dir, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, worker_id: str) -> str:
+        safe = worker_id.replace(os.sep, "_")
+        return os.path.join(self.dir, f"{safe}.update.pkl")
+
+    def save(self, worker_id, update):
+        with self._lock:
+            with open(self._path(worker_id), "wb") as f:
+                pickle.dump(np.asarray(update), f)
+
+    def load(self, worker_id):
+        path = self._path(worker_id)
+        if not os.path.exists(path):
+            return None
+        with self._lock:
+            with open(path, "rb") as f:
+                return pickle.load(f)
+
+    def keys(self):
+        with self._lock:
+            return [f[:-len(".update.pkl")] for f in os.listdir(self.dir)
+                    if f.endswith(".update.pkl")]
+
+    def delete(self, worker_id):
+        path = self._path(worker_id)
+        with self._lock:
+            if os.path.exists(path):
+                os.unlink(path)
+
+    def clear(self):
+        with self._lock:
+            for f in os.listdir(self.dir):
+                if f.endswith(".update.pkl"):
+                    os.unlink(os.path.join(self.dir, f))

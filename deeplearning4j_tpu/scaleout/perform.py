@@ -1,0 +1,56 @@
+"""Worker performers: the compute plugged into distributed workers.
+
+Parity: reference NeuralNetWorkPerformer.java:32-66 /
+BaseMultiLayerNetworkWorkPerformer.java:32-57 — deserialize the conf JSON,
+build the net, fit on the job's DataSet, result = packed params;
+`update()` = setParameters. Configs travel as JSON strings (the reference's
+wire format, SURVEY §5 config system).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from deeplearning4j_tpu.scaleout.api import Job, WorkerPerformer
+
+
+class NeuralNetWorkPerformer(WorkerPerformer):
+    """Fit a MultiLayerNetwork on each job's DataSet; emit packed params."""
+
+    CONF_JSON = "conf_json"  # config key (reference WORKER_PERFORMER wiring)
+
+    def __init__(self, conf_json: str = None, epochs: int = 1):
+        self.conf_json = conf_json
+        self.epochs = epochs
+        self._net = None
+
+    def setup(self, conf: Dict[str, Any]) -> None:
+        self.conf_json = conf[self.CONF_JSON]
+        self.epochs = int(conf.get("epochs", 1))
+        self._ensure_net()
+
+    def _ensure_net(self):
+        if self._net is None:
+            if self.conf_json is None:
+                raise ValueError("NeuralNetWorkPerformer needs conf_json")
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+            self._net = MultiLayerNetwork.from_config_json(self.conf_json)
+        return self._net
+
+    @property
+    def network(self):
+        return self._ensure_net()
+
+    def perform(self, job: Job) -> None:
+        net = self._ensure_net()
+        ds = job.work
+        net.fit(np.asarray(ds.features), np.asarray(ds.labels),
+                epochs=self.epochs)
+        job.result = np.asarray(net.params())
+
+    def update(self, *args: Any) -> None:
+        """Install new global parameters (reference update() = setParams)."""
+        net = self._ensure_net()
+        net.set_parameters(np.asarray(args[0]))

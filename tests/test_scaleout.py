@@ -1,0 +1,278 @@
+"""Scaleout tests — the reference's distributed-without-a-cluster tier
+(BaseTestDistributed.java / IRUnitDriver): full master/worker choreography
+embedded in one process, plus checkpoint round-trip and the on-mesh
+parameter-averaging trainer."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.datasets import ListDataSetIterator
+from deeplearning4j_tpu.datasets.iris import load_iris
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.scaleout import (
+    CollectionJobIterator,
+    DataSetJobIterator,
+    DefaultModelSaver,
+    DistributedRuntime,
+    HogWildWorkRouter,
+    InMemoryStateTracker,
+    IterativeReduceWorkRouter,
+    Job,
+    LocalFileUpdateSaver,
+    NeuralNetWorkPerformer,
+    load_checkpoint,
+)
+from deeplearning4j_tpu.scaleout.aggregator import (
+    ParameterAveragingAggregator,
+    iterate_and_update,
+)
+
+
+def iris_conf_json(iters=5):
+    return (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(4).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(iters).use_adagrad(False)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=3)
+            .pretrain(False).build().to_json())
+
+
+def iris_batches(n_batches=8, batch_size=32):
+    x, y = load_iris()
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(n_batches):
+        idx = rng.choice(len(x), batch_size)
+        out.append(DataSet(np.asarray(x)[idx], np.asarray(y)[idx]))
+    return out
+
+
+class TestStateTracker:
+    def test_worker_registry_and_heartbeats(self):
+        t = InMemoryStateTracker(heartbeat_timeout=0.05)
+        t.add_worker("a")
+        t.add_worker("b")
+        assert set(t.workers()) == {"a", "b"}
+        time.sleep(0.06)
+        t.heartbeat("a")
+        assert t.stale_workers() == ["b"]
+        t.remove_worker("b")
+        assert t.workers() == ["a"]
+
+    def test_eviction_requeues_job(self):
+        t = InMemoryStateTracker()
+        t.add_worker("w")
+        t.add_job(Job(work="batch", worker_id="w"))
+        assert t.job_for("w") is not None
+        t.remove_worker("w")
+        assert t.job_for("w") is None
+
+    def test_counters_kv_early_stop(self):
+        t = InMemoryStateTracker()
+        t.increment("words", 10)
+        t.increment("words", 5)
+        assert t.count("words") == 15
+        t.define("alpha", 0.025)
+        assert t.get("alpha") == 0.025
+        t.set_patience(2)
+        t.report_loss(1.0)
+        t.report_loss(1.0)  # no improvement x2 -> trip
+        t.report_loss(1.0)
+        assert t.early_stop()
+
+    def test_current_model_replication_flags(self):
+        t = InMemoryStateTracker()
+        t.add_worker("w0")
+        t.set_current(np.ones(3))
+        assert t.needs_replicate("w0")
+        t.done_replicating("w0")
+        assert not t.needs_replicate("w0")
+
+
+class TestAggregation:
+    def test_parameter_averaging(self):
+        agg = ParameterAveragingAggregator()
+        agg.accumulate(Job(work=None, worker_id="a", result=np.ones(4)))
+        agg.accumulate(Job(work=None, worker_id="b", result=3 * np.ones(4)))
+        np.testing.assert_allclose(agg.aggregate(), 2 * np.ones(4))
+
+    def test_iterate_and_update_via_file_saver(self, tmp_path):
+        t = InMemoryStateTracker(
+            update_saver=LocalFileUpdateSaver(str(tmp_path)))
+        t.add_update("a", np.zeros(3))
+        t.add_update("b", np.full(3, 2.0))
+        out = iterate_and_update(t, ParameterAveragingAggregator())
+        np.testing.assert_allclose(out, np.ones(3))
+
+
+class TestDistributedRuntime:
+    def _loss_of(self, params_vec):
+        net = MultiLayerNetwork.from_config_json(iris_conf_json())
+        net.set_parameters(params_vec)
+        x, y = load_iris()
+        return net.score(x, y)
+
+    def test_iterative_reduce_converges(self):
+        conf_json = iris_conf_json()
+        seed_net = MultiLayerNetwork.from_config_json(conf_json)
+        loss0 = self._loss_of(np.asarray(seed_net.params()))
+        it = CollectionJobIterator(iris_batches(12))
+        rt = DistributedRuntime(
+            it, lambda: NeuralNetWorkPerformer(conf_json, epochs=1),
+            n_workers=3,
+            initial_params=np.asarray(seed_net.params()))
+        final = rt.run(timeout=120)
+        assert final is not None
+        assert rt.waves >= 2  # multiple averaging waves happened
+        assert self._loss_of(final) < loss0
+
+    def test_hogwild_converges(self):
+        conf_json = iris_conf_json()
+        seed_net = MultiLayerNetwork.from_config_json(conf_json)
+        loss0 = self._loss_of(np.asarray(seed_net.params()))
+        it = CollectionJobIterator(iris_batches(10))
+        rt = DistributedRuntime(
+            it, lambda: NeuralNetWorkPerformer(conf_json, epochs=1),
+            n_workers=2, router_cls=HogWildWorkRouter,
+            initial_params=np.asarray(seed_net.params()))
+        final = rt.run(timeout=120)
+        assert self._loss_of(final) < loss0
+
+    def test_dataset_job_iterator(self):
+        ds_iter = ListDataSetIterator(
+            DataSet(np.random.rand(64, 4).astype(np.float32),
+                    np.eye(3, dtype=np.float32)[np.random.randint(0, 3, 64)]),
+            batch_size=16)
+        it = DataSetJobIterator(ds_iter)
+        seen = 0
+        while it.has_next():
+            job = it.next(f"w{seen % 2}")
+            assert job.work.features.shape[0] == 16
+            seen += 1
+        assert seen == 4
+        it.reset()
+        assert it.has_next()
+
+    def test_worker_eviction_and_reregistration(self):
+        """Pause a worker past the heartbeat timeout -> master evicts it;
+        un-pausing re-registers it (reference MasterActor eviction +
+        WorkerActor re-registering heartbeat)."""
+        conf_json = iris_conf_json(iters=1)
+        it = CollectionJobIterator(iris_batches(6, batch_size=16))
+        tracker = InMemoryStateTracker(heartbeat_timeout=0.3)
+        rt = DistributedRuntime(
+            it, lambda: NeuralNetWorkPerformer(conf_json, epochs=1),
+            n_workers=2, tracker=tracker, heartbeat_interval=0.02)
+        rt.start_workers()
+        deadline = time.time() + 30
+        while len(tracker.workers()) < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        rt.workers[0].paused.set()
+        time.sleep(0.5)
+        rt._evict_stale()
+        assert len(tracker.workers()) == 1
+        rt.workers[0].paused.clear()
+        deadline = time.time() + 30
+        while len(tracker.workers()) < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(tracker.workers()) == 2  # elastic re-join
+        tracker.finish()
+
+
+class TestRuntimeRegressions:
+    def test_initial_params_reach_workers(self):
+        """Workers registering AFTER set_current must pull the seed model
+        before training (late-joiner replication)."""
+        t = InMemoryStateTracker()
+        t.set_current(np.ones(3))
+        t.add_worker("late")
+        assert t.needs_replicate("late")
+
+    def test_periodic_checkpoint_written(self, tmp_path):
+        path = str(tmp_path / "runtime.ckpt")
+        conf_json = iris_conf_json(iters=2)
+        it = CollectionJobIterator(iris_batches(6, batch_size=16))
+        rt = DistributedRuntime(
+            it, lambda: NeuralNetWorkPerformer(conf_json, epochs=1),
+            n_workers=2, model_saver=DefaultModelSaver(path),
+            save_every_waves=1)
+        rt.run(timeout=120)
+        assert os.path.exists(path)
+        net, info = load_checkpoint(path)  # conf_json travels with it
+        assert info["metadata"]["waves"] >= 1
+
+    def test_failed_job_requeued_and_retried(self):
+        class FlakyPerformer(NeuralNetWorkPerformer):
+            calls = 0
+
+            def perform(self, job):
+                FlakyPerformer.calls += 1
+                if FlakyPerformer.calls == 1:
+                    raise RuntimeError("injected failure")
+                super().perform(job)
+
+        conf_json = iris_conf_json(iters=1)
+        it = CollectionJobIterator(iris_batches(3, batch_size=16))
+        rt = DistributedRuntime(
+            it, lambda: FlakyPerformer(conf_json, epochs=1), n_workers=1)
+        final = rt.run(timeout=60)
+        assert final is not None
+        # all 3 batches trained despite the injected failure
+        assert rt.workers[0].performed == 3
+
+
+class TestCheckpoint:
+    def test_save_load_round_trip(self, tmp_path):
+        path = str(tmp_path / "nn-model.ckpt")
+        x, y = load_iris()
+        net = MultiLayerNetwork.from_config_json(iris_conf_json())
+        net.fit(x, y, epochs=2)
+        saver = DefaultModelSaver(path)
+        saver.save(net, iterator_position=7, metadata={"epoch": 2})
+        net2, info = load_checkpoint(path)
+        np.testing.assert_allclose(np.asarray(net.params()),
+                                   np.asarray(net2.params()), atol=1e-6)
+        assert info["iterator_position"] == 7
+        assert info["metadata"]["epoch"] == 2
+        # optimizer state restored -> training continues smoothly
+        assert net2._updater_state is not None
+        s_before = net2.score(x, y)
+        net2.fit(x, y, epochs=1)
+        assert net2.score(x, y) <= s_before + 1e-3
+
+    def test_timestamp_rename_of_prior(self, tmp_path):
+        path = str(tmp_path / "nn-model.ckpt")
+        net = MultiLayerNetwork.from_config_json(iris_conf_json())
+        saver = DefaultModelSaver(path)
+        saver.save(net)
+        saver.save(net)
+        files = os.listdir(tmp_path)
+        assert "nn-model.ckpt" in files
+        assert any(f.startswith("nn-model.ckpt.") for f in files)
+
+
+class TestParameterAveragingTrainer:
+    def test_on_mesh_averaging_converges(self):
+        import jax
+        from deeplearning4j_tpu.parallel import (
+            ParameterAveragingTrainer, make_mesh)
+
+        devices = jax.devices()
+        if len(devices) < 4:
+            pytest.skip("needs 4 virtual devices")
+        mesh = make_mesh({"data": 4}, devices=devices[:4])
+        x, y = load_iris()
+        net = MultiLayerNetwork.from_config_json(iris_conf_json(iters=1))
+        loss0 = net.score(x, y)
+        ds = DataSet(np.asarray(x), np.asarray(y))
+        it = ListDataSetIterator(ds, batch_size=30)
+        trainer = ParameterAveragingTrainer(net, mesh, local_steps=2)
+        trainer.fit(it, epochs=30)
+        assert net.score(x, y) < loss0
