@@ -1,0 +1,39 @@
+"""NLP stack: text pipeline, vocab, embeddings (Word2Vec/GloVe/doc2vec).
+
+Parity: reference deeplearning4j-scaleout/deeplearning4j-nlp (SURVEY §2.6) —
+sentence iterators, tokenizer factories, VocabCache, Huffman coding,
+Word2Vec (skip-gram with hierarchical softmax + negative sampling), GloVe,
+ParagraphVectors, bag-of-words/TF-IDF vectorizers, word-vector serializer.
+
+TPU-native design: the reference's per-pair hogwild axpy hot loop
+(InMemoryLookupTable.iterateSample :188) becomes BATCHED device training —
+pairs are mined on the host, shipped as index tensors, and one jitted step
+computes the loss over the whole batch; autodiff turns the embedding
+gathers into scatter-add updates (deterministic segment-sums instead of
+lock-free races).
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (  # noqa: F401
+    DefaultTokenizer,
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.sentence_iterator import (  # noqa: F401
+    CollectionSentenceIterator,
+    FileSentenceIterator,
+    LabelAwareSentenceIterator,
+    LineSentenceIterator,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord  # noqa: F401
+from deeplearning4j_tpu.nlp.huffman import build_huffman  # noqa: F401
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
+from deeplearning4j_tpu.nlp.glove import CoOccurrences, Glove  # noqa: F401
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors  # noqa: F401
+from deeplearning4j_tpu.nlp.serializer import (  # noqa: F401
+    load_word_vectors,
+    save_word_vectors,
+)
+from deeplearning4j_tpu.nlp.vectorizers import (  # noqa: F401
+    BagOfWordsVectorizer,
+    TfidfVectorizer,
+)

@@ -1,0 +1,85 @@
+"""ParagraphVectors (doc2vec, PV-DBOW flavor).
+
+Parity: reference nlp/models/paragraphvectors/ParagraphVectors.java:53-61 —
+extends Word2Vec by adding label "words" trained on every window of their
+document, so each label gets an embedding in the same space as the words.
+
+TPU-native design: labels are appended to the vocab as pseudo-words; pair
+mining emits (label, context-word) pairs for every word of the labeled
+sentence alongside the normal skip-gram pairs; training reuses the batched
+Word2Vec step unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sentence_iterator import LabelAwareSentenceIterator
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+class ParagraphVectors(Word2Vec):
+    def __init__(self, labeled_sentences=None, **kw):
+        """`labeled_sentences`: iterable of (label, sentence) pairs or a
+        LabelAwareSentenceIterator."""
+        if isinstance(labeled_sentences, LabelAwareSentenceIterator):
+            pairs = list(labeled_sentences.pairs)
+        else:
+            pairs = list(labeled_sentences or [])
+        self.labeled = pairs
+        super().__init__([s for _, s in pairs], **kw)
+        self.labels = sorted({lb for lb, _ in pairs})
+
+    def _extend_vocab(self) -> None:
+        # labels enter the vocab as pseudo-words with doc-level counts
+        # AFTER truncation (so min_word_frequency can't drop them) and
+        # BEFORE the single Huffman build in Word2Vec.build_vocab
+        for label in self.labels:
+            n_docs = sum(1 for lb, _ in self.labeled if lb == label)
+            vw = self.vocab.add_token(self._label_token(label), by=n_docs)
+            self.vocab.add_word_to_index(vw.word)
+
+    @staticmethod
+    def _label_token(label: str) -> str:
+        return f"__label__{label}"
+
+    def _mine_pairs(self, rng: np.random.RandomState
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+        centers, contexts = super()._mine_pairs(rng)
+        # PV-DBOW: each doc's label predicts every word of the doc
+        # (reference trains the label word in every window, :61)
+        lab_centers: List[int] = []
+        lab_contexts: List[int] = []
+        for label, sentence in self.labeled:
+            li = self.vocab.index_of(self._label_token(label))
+            if li < 0:
+                continue
+            for t in self.tokenizer_factory.tokenize(sentence):
+                wi = self.vocab.index_of(t)
+                if wi >= 0:
+                    lab_centers.append(wi)   # predict word via its codes
+                    lab_contexts.append(li)  # from the label's vector
+        return (np.concatenate([centers,
+                                np.asarray(lab_centers, np.int32)]),
+                np.concatenate([contexts,
+                                np.asarray(lab_contexts, np.int32)]))
+
+    # ---------------------------------------------------------------- query
+    def label_vector(self, label: str) -> Optional[np.ndarray]:
+        return self.get_word_vector(self._label_token(label))
+
+    def similarity_to_label(self, word: str, label: str) -> float:
+        return self.similarity(word, self._label_token(label))
+
+    def nearest_labels(self, word: str, n: int = 5):
+        i = self.vocab.index_of(word)
+        if i < 0:
+            return []
+        out = []
+        for label in self.labels:
+            out.append((label, self.similarity(word,
+                                               self._label_token(label))))
+        out.sort(key=lambda t: -t[1])
+        return out[:n]

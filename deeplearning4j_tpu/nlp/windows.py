@@ -1,0 +1,56 @@
+"""Moving-window featurization for word-level classification.
+
+Parity: reference nlp/text/movingwindow/ — `Window` (tokens + focus word +
+label), `Windows.windows(text, windowSize)` (pad with <s>/</s>, slide over
+tokens), and `WindowConverter.asExampleMatrix` (concatenate the word
+vectors of the window into one input row). Feeds the Word2Vec-based
+classification pipeline (Word2VecDataSetIterator)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+BEGIN, END = "<s>", "</s>"
+
+
+class Window:
+    def __init__(self, words: Sequence[str], focus_index: int,
+                 label: Optional[str] = None):
+        self.words = list(words)
+        self.focus_index = focus_index
+        self.label = label
+
+    def focus_word(self) -> str:
+        return self.words[self.focus_index]
+
+    def __repr__(self):
+        return f"Window({self.words}, focus={self.focus_word()!r})"
+
+
+def windows(tokens: Sequence[str], window_size: int = 5,
+            label: Optional[str] = None) -> List[Window]:
+    """Slide a centered window over tokens, padding the edges
+    (reference Windows.windows)."""
+    if window_size % 2 == 0:
+        raise ValueError("window_size must be odd")
+    half = window_size // 2
+    padded = [BEGIN] * half + list(tokens) + [END] * half
+    out = []
+    for i in range(len(tokens)):
+        out.append(Window(padded[i:i + window_size], half, label=label))
+    return out
+
+
+def window_as_vector(window: Window, word_vectors) -> np.ndarray:
+    """Concatenate the window's word vectors into one example row
+    (reference WindowConverter.asExampleMatrix). Unknown/pad words get
+    zero vectors."""
+    d = word_vectors.syn0.shape[1]
+    parts = []
+    for w in window.words:
+        vec = word_vectors.get_word_vector(w)
+        parts.append(np.zeros(d, np.float32) if vec is None
+                     else np.asarray(vec, np.float32))
+    return np.concatenate(parts)

@@ -1,0 +1,312 @@
+"""Word2Vec: skip-gram with hierarchical softmax and negative sampling.
+
+Parity: reference nlp/models/word2vec/Word2Vec.java (fit :101, buildVocab
+:257, trainSentence :298, skipGram :314, iterate :337, lr decay by words
+seen :191-296) + InMemoryLookupTable.java (syn0/syn1/syn1Neg, unigram
+table resetWeights :88, iterateSample :188-260) + WordVectorsImpl
+(similarity / wordsNearest).
+
+TPU-native design: the reference's hot loop does ONE (dot, sigmoid, axpy)
+at a time per (center, context, code-bit), racing hogwild threads over
+shared syn0/syn1. Here the host mines (center, context) pairs + their
+Huffman codes/points into padded index tensors, and a single jitted step
+computes the batch loss:
+
+    HS:  BCE over dot(syn0[context], syn1[points]) against (1 - codes)
+    NEG: BCE over dot(syn0[context], syn1neg[target|negatives])
+
+jax.grad turns the gathers into scatter-adds — a deterministic segment-sum
+formulation of the same update (colliding pairs ACCUMULATE instead of
+racing), running on the MXU over thousands of pairs at once. Negative
+samples are drawn on-device from the unigram^0.75 table via
+jax.random.categorical over precomputed logits.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.huffman import build_huffman, max_code_length
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    CollectionSentenceIterator,
+    SentenceIterator,
+)
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory,
+    TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, build_vocab
+
+log = logging.getLogger(__name__)
+
+
+class WordVectors:
+    """Similarity / nearest-words API over the learned table
+    (reference WordVectorsImpl.java)."""
+
+    def __init__(self, cache: VocabCache, syn0: np.ndarray):
+        self.vocab = cache
+        self.syn0 = np.asarray(syn0)
+        norms = np.linalg.norm(self.syn0, axis=1, keepdims=True)
+        self._unit = self.syn0 / np.maximum(norms, 1e-12)
+
+    def _require_fitted(self) -> None:
+        if getattr(self, "syn0", None) is None \
+                or getattr(self, "_unit", None) is None:
+            raise RuntimeError(
+                f"{type(self).__name__} has no trained vectors yet — "
+                "call fit() first")
+
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        self._require_fitted()
+        i = self.vocab.index_of(word)
+        return self.syn0[i] if i >= 0 else None
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab.index_of(word) >= 0
+
+    def similarity(self, w1: str, w2: str) -> float:
+        self._require_fitted()
+        i, j = self.vocab.index_of(w1), self.vocab.index_of(w2)
+        if i < 0 or j < 0:
+            return float("nan")
+        return float(self._unit[i] @ self._unit[j])
+
+    def words_nearest(self, word: str, n: int = 10) -> List[Tuple[str, float]]:
+        self._require_fitted()
+        i = self.vocab.index_of(word)
+        if i < 0:
+            return []
+        sims = self._unit @ self._unit[i]
+        order = np.argsort(-sims)
+        out = []
+        for j in order:
+            if j == i:
+                continue
+            out.append((self.vocab.word_at(int(j)), float(sims[j])))
+            if len(out) >= n:
+                break
+        return out
+
+
+class Word2Vec(WordVectors):
+    """Skip-gram trainer (builder-style kwargs mirror the reference's
+    Word2Vec.Builder: layerSize/windowSize/minWordFrequency/iterations/
+    learningRate/minLearningRate/negativeSample/sample/seed)."""
+
+    def __init__(self, sentences=None, *, layer_size: int = 100,
+                 window: int = 5, min_word_frequency: float = 1.0,
+                 iterations: int = 1, learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4, negative: int = 0,
+                 sample: float = 0.0, batch_pairs: int = 4096,
+                 seed: int = 123,
+                 tokenizer_factory: Optional[TokenizerFactory] = None):
+        self.layer_size = layer_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.iterations = iterations
+        self.alpha = learning_rate
+        self.min_alpha = min_learning_rate
+        self.negative = negative
+        self.sample = sample
+        self.batch_pairs = batch_pairs
+        self.seed = seed
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        if isinstance(sentences, SentenceIterator):
+            self.sentence_iter = sentences
+        elif sentences is not None:
+            self.sentence_iter = CollectionSentenceIterator(list(sentences))
+        else:
+            self.sentence_iter = None
+        self.vocab = VocabCache()
+        self.syn0 = None
+        self.syn1 = None
+        self.syn1neg = None
+        self._code_len = 0
+        self._key = jax.random.PRNGKey(seed)
+
+    # ----------------------------------------------------------- vocab/init
+    def build_vocab(self) -> None:
+        """reference buildVocab :257 + Huffman(vocab).build() :348."""
+        build_vocab(self.sentence_iter, self.tokenizer_factory,
+                    self.min_word_frequency, self.vocab)
+        self._extend_vocab()  # hook: subclasses add pseudo-words (labels)
+        build_huffman(self.vocab)
+        self._code_len = max(1, max_code_length(self.vocab))
+
+    def _extend_vocab(self) -> None:
+        pass
+
+    def reset_weights(self) -> None:
+        """reference InMemoryLookupTable.resetWeights :88: syn0 uniform in
+        +-0.5/dim, syn1 zeros."""
+        n, d = self.vocab.num_words(), self.layer_size
+        self._key, k = jax.random.split(self._key)
+        self.syn0 = jax.random.uniform(k, (n, d), jnp.float32,
+                                       -0.5 / d, 0.5 / d)
+        if self.negative > 0:
+            self.syn1neg = jnp.zeros((n, d), jnp.float32)
+        else:  # hierarchical softmax path
+            self.syn1 = jnp.zeros((n, d), jnp.float32)
+
+    def _unigram_logits(self) -> jnp.ndarray:
+        """unigram^0.75 sampling distribution (the reference's table)."""
+        counts = np.array([vw.count for vw in self.vocab.vocab_words()],
+                          np.float64)
+        probs = counts ** 0.75
+        probs /= probs.sum()
+        return jnp.asarray(np.log(np.maximum(probs, 1e-12)), jnp.float32)
+
+    # ------------------------------------------------------------- training
+    def _codes_points(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Pad per-word Huffman codes/points to (V, L) with a mask."""
+        v, L = self.vocab.num_words(), self._code_len
+        codes = np.zeros((v, L), np.float32)
+        points = np.zeros((v, L), np.int32)
+        mask = np.zeros((v, L), np.float32)
+        for vw in self.vocab.vocab_words():
+            ln = vw.code_length()
+            codes[vw.index, :ln] = vw.codes
+            points[vw.index, :ln] = vw.points
+            mask[vw.index, :ln] = 1.0
+        return codes, points, mask
+
+    def _mine_pairs(self, rng: np.random.RandomState
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Host-side pair mining: skip-gram windows with the word2vec random
+        window shrink (reference skipGram :314 trains syn0[context] against
+        the CENTER word's codes) + optional frequent-word subsampling."""
+        centers, contexts = [], []
+        total = max(1.0, self.vocab.total_word_count)
+        for sentence in self.sentence_iter:
+            toks = self.tokenizer_factory.tokenize(sentence)
+            idxs = [self.vocab.index_of(t) for t in toks]
+            idxs = [i for i in idxs if i >= 0]
+            if self.sample > 0:
+                kept = []
+                for i in idxs:
+                    f = self.vocab.word_frequency(self.vocab.word_at(i)) / total
+                    keep_p = (np.sqrt(f / self.sample) + 1) * self.sample / f
+                    if rng.rand() < keep_p:
+                        kept.append(i)
+                idxs = kept
+            for pos, center in enumerate(idxs):
+                b = rng.randint(1, self.window + 1)  # shrunk window
+                for off in range(-b, b + 1):
+                    if off == 0:
+                        continue
+                    j = pos + off
+                    if 0 <= j < len(idxs):
+                        centers.append(center)
+                        contexts.append(idxs[j])
+        return (np.asarray(centers, np.int32),
+                np.asarray(contexts, np.int32))
+
+    def _build_step(self):
+        codes, points, mask = self._codes_points()
+        codes_t, points_t, mask_t = (jnp.asarray(codes), jnp.asarray(points),
+                                     jnp.asarray(mask))
+        negative = self.negative
+        uni_logits = self._unigram_logits() if negative > 0 else None
+
+        def loss_fn(tables, centers, contexts, negs):
+            syn0 = tables["syn0"]
+            l1 = syn0[contexts]  # (B, D) — reference trains syn0[context]
+            loss = 0.0
+            if "syn1" in tables:
+                # hierarchical softmax over the center word's code path
+                p = points_t[centers]          # (B, L)
+                c = codes_t[centers]           # (B, L)
+                m = mask_t[centers]            # (B, L)
+                logits = jnp.einsum("bd,bld->bl", l1, tables["syn1"][p])
+                labels = 1.0 - c               # word2vec label convention
+                bce = jnp.maximum(logits, 0) - logits * labels + \
+                    jnp.log1p(jnp.exp(-jnp.abs(logits)))
+                # sum over the code path, mean over pairs: matches the
+                # reference's per-pair accumulation of one update per bit
+                loss = loss + jnp.mean(jnp.sum(bce * m, axis=1))
+            if "syn1neg" in tables:
+                tgt = jnp.concatenate([centers[:, None], negs], axis=1)
+                labels = jnp.concatenate(
+                    [jnp.ones_like(centers[:, None], jnp.float32),
+                     jnp.zeros_like(negs, jnp.float32)], axis=1)
+                # mask negatives that drew the positive target itself
+                # (reference: `if (target == word) continue`)
+                valid = jnp.concatenate(
+                    [jnp.ones_like(centers[:, None], jnp.float32),
+                     (negs != centers[:, None]).astype(jnp.float32)], axis=1)
+                logits = jnp.einsum("bd,bkd->bk", l1, tables["syn1neg"][tgt])
+                bce = jnp.maximum(logits, 0) - logits * labels + \
+                    jnp.log1p(jnp.exp(-jnp.abs(logits)))
+                loss = loss + jnp.mean(jnp.sum(bce * valid, axis=1))
+            return loss
+
+        @jax.jit
+        def step(tables, centers, contexts, alpha, key):
+            if negative > 0:
+                negs = jax.random.categorical(
+                    key, uni_logits, shape=(centers.shape[0], negative))
+            else:
+                negs = jnp.zeros((centers.shape[0], 0), jnp.int32)
+            loss, grads = jax.value_and_grad(loss_fn)(
+                tables, centers, contexts, negs)
+            tables = jax.tree_util.tree_map(
+                lambda t, g: t - alpha * g, tables, grads)
+            return tables, loss
+
+        return step
+
+    def fit(self) -> "Word2Vec":
+        """reference fit :101: build vocab, Huffman, reset weights, train
+        with lr decaying by pairs seen."""
+        if self.sentence_iter is None:
+            raise ValueError("Word2Vec needs sentences")
+        if self.vocab.num_words() == 0:
+            self.build_vocab()
+        if self.syn0 is None:
+            self.reset_weights()
+        rng = np.random.RandomState(self.seed)
+        centers, contexts = self._mine_pairs(rng)
+        if centers.size == 0:
+            raise ValueError("No training pairs (vocab/corpus too small)")
+        step = self._build_step()
+
+        tables = {"syn0": self.syn0}
+        if self.syn1 is not None:
+            tables["syn1"] = self.syn1
+        if self.syn1neg is not None:
+            tables["syn1neg"] = self.syn1neg
+        n = centers.shape[0]
+        total_steps = max(1, self.iterations * ((n - 1) // self.batch_pairs
+                                                + 1))
+        step_i = 0
+        loss = None
+        for _ in range(self.iterations):
+            order = rng.permutation(n)
+            for lo in range(0, n, self.batch_pairs):
+                sel = order[lo:lo + self.batch_pairs]
+                # static batch shape: tile the tail so jit compiles once
+                if sel.size < self.batch_pairs:
+                    sel = np.concatenate(
+                        [sel, sel[np.arange(self.batch_pairs - sel.size)
+                                  % sel.size]])
+                alpha = max(self.min_alpha,
+                            self.alpha * (1.0 - step_i / total_steps))
+                self._key, k = jax.random.split(self._key)
+                tables, loss = step(tables, jnp.asarray(centers[sel]),
+                                    jnp.asarray(contexts[sel]),
+                                    jnp.float32(alpha), k)
+                step_i += 1
+        self.syn0 = tables["syn0"]
+        self.syn1 = tables.get("syn1")
+        self.syn1neg = tables.get("syn1neg")
+        log.info("word2vec trained: %d pairs, final loss %.4f", n,
+                 float(loss))
+        # refresh the WordVectors view
+        WordVectors.__init__(self, self.vocab, np.asarray(self.syn0))
+        return self

@@ -64,9 +64,17 @@ class ParameterAveragingTrainer:
 
             (p, s), scores = lax.scan(body, (params, upd_state),
                                       (xs, ys, keys))
-            # THE iterative-reduce average, as an ICI collective
-            p = jax.tree_util.tree_map(lambda a: lax.pmean(a, axis), p)
-            s = jax.tree_util.tree_map(lambda a: lax.pmean(a, axis), s)
+            # THE iterative-reduce average, as an ICI collective. Integer
+            # leaves (e.g. the updater's iteration counter — identical on
+            # every replica) use pmax to stay integer-typed; pmean would
+            # drift them to float and retrigger compilation.
+            def avg(a):
+                if jnp.issubdtype(a.dtype, jnp.floating):
+                    return lax.pmean(a, axis)
+                return lax.pmax(a, axis)
+
+            p = jax.tree_util.tree_map(avg, p)
+            s = jax.tree_util.tree_map(avg, s)
             return p, s, lax.pmean(jnp.mean(scores), axis)
 
         fn = _shard_map(
@@ -106,8 +114,19 @@ class ParameterAveragingTrainer:
             for listener in net.listeners:
                 listener.iteration_done(net, waves - 1, float(score))
 
+    @staticmethod
+    def _pad_rows(arr: np.ndarray, rows: int) -> np.ndarray:
+        """Tile a ragged tail batch up to the wave's uniform batch size."""
+        if arr.shape[0] == rows:
+            return arr
+        idx = np.arange(rows) % arr.shape[0]
+        return arr[idx]
+
     def _run_wave(self, params, upd_state, batch):
         d, k = self.n_devices, self.local_steps
+        rows = max(b[0].shape[0] for b in batch)
+        batch = [(self._pad_rows(x, rows), self._pad_rows(y, rows))
+                 for x, y in batch]
         xs = np.stack([b[0] for b in batch]).reshape(
             d, k, *batch[0][0].shape)
         ys = np.stack([b[1] for b in batch]).reshape(

@@ -79,7 +79,8 @@ class DataParallelTrainer:
 
     def fit(self, iterator, epochs: int = 1) -> None:
         net = self.network
-        upd_state = self.updater.init(net._params)
+        upd_state = (net._updater_state if net._updater_state is not None
+                     else self.updater.init(net._params))
         params = net._params
         score = None
         steps = 0

@@ -154,6 +154,11 @@ class WorkRouter:
 
     WORK_ROUTER = "work_router"  # config key parity
 
+    #: True = barrier-style waves (aggregate when all workers report);
+    #: False = async/hogwild (merge updates as they arrive, send_work()
+    #: gates each dispatch). Subclasses declare their semantics here.
+    synchronous: bool = True
+
     def __init__(self, state_tracker):
         self.tracker = state_tracker
 
@@ -179,6 +184,8 @@ class IterativeReduceWorkRouter(WorkRouter):
 class HogWildWorkRouter(WorkRouter):
     """Asynchronous DP: always send — lock-free hogwild-style updates
     (reference HogWildWorkRouter.java:44-47)."""
+
+    synchronous = False
 
     def send_work(self) -> bool:
         return True

@@ -42,42 +42,8 @@ class DefaultModelSaver(ModelSaver):
         self.path = path
         self.keep_old = keep_old
 
-    def save(self, network, *, iterator_position: Optional[int] = None,
-             metadata: Optional[Dict[str, Any]] = None) -> str:
-        payload = {
-            "format_version": 1,
-            "conf_json": network.to_json(),
-            "params": np.asarray(network.params()),
-            "updater_state": (_to_numpy_tree(network._updater_state)
-                              if network._updater_state is not None else None),
-            "iteration_count": network._iteration_count,
-            "iterator_position": iterator_position,
-            "metadata": metadata or {},
-            "saved_at": time.time(),
-        }
-        if self.keep_old and os.path.exists(self.path):
-            os.replace(self.path, f"{self.path}.{int(time.time() * 1000)}")
-        tmp = f"{self.path}.tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(payload, f)
-        os.replace(tmp, self.path)  # atomic publish
-        return self.path
-
-    def save_current(self, params, *, conf_json: Optional[str] = None,
-                     metadata: Optional[Dict[str, Any]] = None) -> str:
-        """Checkpoint a packed parameter vector directly — the runtime-level
-        save path (DistributedRuntime periodic checkpoints). Loadable by
-        `load_checkpoint` when conf_json is provided."""
-        payload = {
-            "format_version": 1,
-            "conf_json": conf_json,
-            "params": np.asarray(params),
-            "updater_state": None,
-            "iteration_count": 0,
-            "iterator_position": None,
-            "metadata": metadata or {},
-            "saved_at": time.time(),
-        }
+    def _write(self, payload: Dict[str, Any]) -> str:
+        """Timestamp-rename any prior checkpoint, then atomically publish."""
         if self.keep_old and os.path.exists(self.path):
             os.replace(self.path, f"{self.path}.{int(time.time() * 1000)}")
         tmp = f"{self.path}.tmp"
@@ -85,6 +51,40 @@ class DefaultModelSaver(ModelSaver):
             pickle.dump(payload, f)
         os.replace(tmp, self.path)
         return self.path
+
+    @staticmethod
+    def _payload(*, conf_json, params, updater_state=None,
+                 iteration_count=0, iterator_position=None, metadata=None):
+        return {
+            "format_version": 1,
+            "conf_json": conf_json,
+            "params": np.asarray(params),
+            "updater_state": updater_state,
+            "iteration_count": iteration_count,
+            "iterator_position": iterator_position,
+            "metadata": metadata or {},
+            "saved_at": time.time(),
+        }
+
+    def save(self, network, *, iterator_position: Optional[int] = None,
+             metadata: Optional[Dict[str, Any]] = None) -> str:
+        return self._write(self._payload(
+            conf_json=network.to_json(),
+            params=network.params(),
+            updater_state=(_to_numpy_tree(network._updater_state)
+                           if network._updater_state is not None else None),
+            iteration_count=network._iteration_count,
+            iterator_position=iterator_position,
+            metadata=metadata,
+        ))
+
+    def save_current(self, params, *, conf_json: Optional[str] = None,
+                     metadata: Optional[Dict[str, Any]] = None) -> str:
+        """Checkpoint a packed parameter vector directly — the runtime-level
+        save path (DistributedRuntime periodic checkpoints). Loadable by
+        `load_checkpoint` when conf_json is provided."""
+        return self._write(self._payload(
+            conf_json=conf_json, params=params, metadata=metadata))
 
 
 def load_checkpoint(path: str):

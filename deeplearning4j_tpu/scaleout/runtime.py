@@ -118,12 +118,16 @@ class DistributedRuntime:
         self.n_workers = n_workers
         self.performers = [performer_factory() for _ in range(n_workers)]
         self.router = (router_cls or IterativeReduceWorkRouter)(self.tracker)
-        self.sync = isinstance(self.router, IterativeReduceWorkRouter)
+        # Declarative router policy: barrier-style routers aggregate in
+        # waves; async routers merge updates as they arrive, with
+        # send_work() gating each dispatch (reference WorkRouter.sendWork).
+        self.sync = self.router.synchronous
         self.interval = heartbeat_interval
         self.model_saver = model_saver
         self.save_every_waves = save_every_waves
         self.workers: List[_Worker] = []
         self.waves = 0
+        self._orphan_jobs: List[Job] = []  # evicted workers' in-flight jobs
         if initial_params is not None:
             self.tracker.set_current(np.asarray(initial_params))
 
@@ -143,15 +147,23 @@ class DistributedRuntime:
     def _dispatch_wave(self) -> int:
         sent = 0
         for wid in self._free_workers():
-            if not self.job_iterator.has_next():
-                break
-            try:
-                job = self.job_iterator.next(wid)
-            except StopIteration:
+            if self._orphan_jobs:  # re-serve evicted workers' jobs first
+                job = self._orphan_jobs.pop()
+                job.worker_id = wid
+                job.result = None
+            elif self.job_iterator.has_next():
+                try:
+                    job = self.job_iterator.next(wid)
+                except StopIteration:
+                    break
+            else:
                 break
             self.router.route_job(job)
             sent += 1
         return sent
+
+    def _has_work(self) -> bool:
+        return bool(self._orphan_jobs) or self.job_iterator.has_next()
 
     def _aggregate_and_publish(self):
         """Average pending updates into the new global model (reference
@@ -200,7 +212,14 @@ class DistributedRuntime:
     def _evict_stale(self):
         for wid in self.tracker.stale_workers():
             log.warning("evicting stale worker %s", wid)
-            self.tracker.remove_worker(wid)
+            orphan = self.tracker.remove_worker(wid)
+            if orphan is not None and orphan.result is None:
+                # fresh Job: the evicted worker may still be mutating the
+                # old instance; sharing it would let a late completion
+                # poison the reassigned copy
+                self._orphan_jobs.append(Job(work=orphan.work,
+                                             worker_id=orphan.worker_id,
+                                             retries=orphan.retries))
 
     # ---------------------------------------------------------------- train
     def run(self, timeout: float = 120.0) -> np.ndarray:
@@ -223,14 +242,15 @@ class DistributedRuntime:
                 if n_updates and not n_outstanding:
                     self._aggregate_and_publish()
                 elif not n_updates and not n_outstanding:
-                    if not self.job_iterator.has_next():
+                    if not self._has_work():
                         break
                     self._dispatch_wave()
             else:
                 if n_updates:
                     self._aggregate_and_publish()
-                if self.job_iterator.has_next():
-                    self._dispatch_wave()
+                if self._has_work():
+                    if self.router.send_work():
+                        self._dispatch_wave()
                 elif not n_outstanding and not n_updates:
                     break
             if self.tracker.early_stop():

@@ -47,6 +47,7 @@ class InMemoryStateTracker:
         self._done = False
         self.heartbeat_timeout = heartbeat_timeout
         # early-stop state (reference BaseHazelCastStateTracker.java:70-93)
+        self._initial_patience = 40.0
         self._patience = 40.0
         self._best_loss = float("inf")
         self._early_stop = False
@@ -66,14 +67,16 @@ class InMemoryStateTracker:
                 # training (reference WorkerActor replication on join)
                 self._needs_replicate[worker_id] = True
 
-    def remove_worker(self, worker_id: str) -> None:
-        """Evict a worker and requeue its job
-        (reference removeWorker :875-880 clears that worker's job)."""
+    def remove_worker(self, worker_id: str) -> Optional[Job]:
+        """Evict a worker; returns its in-flight job (if any) so the caller
+        can reroute it to a live worker (reference removeWorker :875-880 +
+        MasterActor stale-job requeue :117-131)."""
         with self._lock:
             self._workers.pop(worker_id, None)
             self._heartbeats.pop(worker_id, None)
-            self._jobs.pop(worker_id, None)
+            orphan = self._jobs.pop(worker_id, None)
             self._needs_replicate.pop(worker_id, None)
+            return orphan
 
     def workers(self) -> List[str]:
         with self._lock:
@@ -188,6 +191,7 @@ class InMemoryStateTracker:
     # ------------------------------------------------------------ early stop
     def set_patience(self, patience: float) -> None:
         with self._lock:
+            self._initial_patience = patience
             self._patience = patience
 
     def patience(self) -> float:
@@ -200,7 +204,7 @@ class InMemoryStateTracker:
         with self._lock:
             if loss < self._best_loss - self._improvement_threshold:
                 self._best_loss = loss
-                self._patience = max(self._patience, 2.0)
+                self._patience = self._initial_patience  # full reset
             else:
                 self._patience -= 1.0
                 if self._patience <= 0:

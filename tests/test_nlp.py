@@ -1,0 +1,209 @@
+"""NLP stack tests (reference Word2VecTests, GloveTest, ParagraphVectorsTest,
+WordVectorSerializerTest, TextPipeline/tokenizer/vectorizer tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BagOfWordsVectorizer,
+    CollectionSentenceIterator,
+    CoOccurrences,
+    DefaultTokenizerFactory,
+    Glove,
+    LabelAwareSentenceIterator,
+    LineSentenceIterator,
+    NGramTokenizerFactory,
+    ParagraphVectors,
+    TfidfVectorizer,
+    VocabCache,
+    Word2Vec,
+    build_huffman,
+    load_word_vectors,
+    save_word_vectors,
+)
+from deeplearning4j_tpu.nlp.vocab import build_vocab
+from deeplearning4j_tpu.nlp.windows import window_as_vector, windows
+
+
+def toy_corpus(n_reps=40):
+    """Two topic clusters so embeddings have signal."""
+    base = [
+        "the cat sat on the mat",
+        "the dog sat on the rug",
+        "the cat and the dog play in the yard",
+        "a furry cat chases a furry dog",
+        "the king wears the crown in the castle",
+        "the queen wears the crown in the castle",
+        "a royal king and a royal queen sit on the throne",
+    ]
+    return base * n_reps
+
+
+class TestTokenization:
+    def test_default_tokenizer(self):
+        toks = DefaultTokenizerFactory().tokenize("Hello, World! It's me.")
+        assert toks == ["hello", "world", "it's", "me"]
+
+    def test_ngram_tokenizer(self):
+        toks = NGramTokenizerFactory(1, 2).tokenize("a b c")
+        assert "a" in toks and "a_b" in toks and "b_c" in toks
+
+
+class TestSentenceIterators:
+    def test_collection(self):
+        it = CollectionSentenceIterator(["one", "two"])
+        assert list(it) == ["one", "two"]
+        assert list(it) == ["one", "two"]  # reset works
+
+    def test_line_file(self, tmp_path):
+        p = tmp_path / "corpus.txt"
+        p.write_text("line one\nline two\n")
+        it = LineSentenceIterator(str(p))
+        assert list(it) == ["line one", "line two"]
+
+    def test_label_aware(self):
+        it = LabelAwareSentenceIterator([("pos", "good"), ("neg", "bad")])
+        out = []
+        it.reset()
+        while it.has_next():
+            s = it.next_sentence()
+            out.append((it.current_label(), s))
+        assert out == [("pos", "good"), ("neg", "bad")]
+
+
+class TestVocabHuffman:
+    def test_vocab_counts_and_truncation(self):
+        cache = build_vocab(toy_corpus(1), DefaultTokenizerFactory(),
+                            min_word_frequency=2)
+        assert cache.word_frequency("the") >= 4
+        assert cache.index_of("the") == 0  # most frequent first
+        assert not cache.contains("play")  # freq 1 truncated
+
+    def test_huffman_codes(self):
+        cache = build_vocab(toy_corpus(1), DefaultTokenizerFactory())
+        build_huffman(cache)
+        words = cache.vocab_words()
+        # every word gets a code; frequent words get SHORTER codes
+        assert all(vw.code_length() > 0 for vw in words)
+        most, least = words[0], words[-1]
+        assert most.code_length() <= least.code_length()
+        # codes are unique
+        codes = {tuple(vw.codes) for vw in words}
+        assert len(codes) == len(words)
+        # points index valid syn1 rows (inner nodes < vocab size)
+        for vw in words:
+            assert all(0 <= p < cache.num_words() for p in vw.points)
+
+
+class TestWord2Vec:
+    @pytest.mark.parametrize("negative,lr", [(0, 1.0), (5, 0.5)])
+    def test_skipgram_learns_topic_structure(self, negative, lr):
+        w2v = Word2Vec(toy_corpus(), layer_size=32, window=3,
+                       min_word_frequency=3, iterations=20,
+                       learning_rate=lr, negative=negative,
+                       batch_pairs=2048, seed=7).fit()
+        # in-topic similarity should beat cross-topic
+        assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "king")
+        assert w2v.similarity("king", "queen") > w2v.similarity("king", "mat")
+
+    def test_words_nearest(self):
+        w2v = Word2Vec(toy_corpus(), layer_size=16, window=3,
+                       min_word_frequency=3, iterations=8, seed=3).fit()
+        names = [w for w, _ in w2v.words_nearest("cat", n=5)]
+        assert "cat" not in names and len(names) == 5
+
+    def test_unknown_word(self):
+        w2v = Word2Vec(toy_corpus(1), layer_size=8, iterations=1).fit()
+        assert not w2v.has_word("zebra")
+        assert w2v.get_word_vector("zebra") is None
+        assert np.isnan(w2v.similarity("zebra", "cat"))
+
+
+class TestSerializer:
+    def _small_model(self):
+        return Word2Vec(toy_corpus(1), layer_size=8, iterations=1,
+                        seed=1).fit()
+
+    @pytest.mark.parametrize("binary", [False, True])
+    def test_round_trip(self, tmp_path, binary):
+        w2v = self._small_model()
+        path = str(tmp_path / ("vecs.bin" if binary else "vecs.txt"))
+        save_word_vectors(w2v, path, binary=binary)
+        loaded = load_word_vectors(path, binary=binary)
+        assert loaded.vocab.num_words() == w2v.vocab.num_words()
+        for w in ["the", "cat"]:
+            np.testing.assert_allclose(loaded.get_word_vector(w),
+                                       w2v.get_word_vector(w), atol=1e-4)
+
+
+class TestGlove:
+    def test_cooccurrence_counting(self):
+        cache = build_vocab(["a b c", "a b"], DefaultTokenizerFactory())
+        co = CoOccurrences(CollectionSentenceIterator(["a b c", "a b"]),
+                           DefaultTokenizerFactory(), cache,
+                           window=2).calc()
+        ia, ib = cache.index_of("a"), cache.index_of("b")
+        assert co.counts[(ia, ib)] == 2.0  # adjacent twice, 1/1 weight
+        assert co.counts[(ib, ia)] == 2.0  # symmetric
+
+    def test_glove_learns_topic_structure(self):
+        """Two word pools with heavy within-pool co-occurrence — the
+        block-structured signal GloVe's weighted-LSQ objective captures."""
+        rng = np.random.RandomState(0)
+        animals = ["cat", "dog", "horse", "bird", "fish"]
+        royals = ["king", "queen", "prince", "duke", "crown"]
+        corpus = []
+        for _ in range(300):
+            pool = animals if rng.rand() < 0.5 else royals
+            corpus.append(" ".join(rng.choice(pool, 6)))
+        glove = Glove(corpus, layer_size=8, window=4,
+                      min_word_frequency=3, iterations=200,
+                      learning_rate=0.05, seed=11).fit()
+        assert glove.similarity("cat", "dog") > glove.similarity("cat", "king")
+        assert glove.similarity("king", "queen") > glove.similarity("queen",
+                                                                    "fish")
+
+
+class TestParagraphVectors:
+    def test_labels_embed_near_their_words(self):
+        pairs = ([("animals", s) for s in toy_corpus(20)[:3 * 20]]
+                 + [("royalty", s) for s in toy_corpus(20)[3 * 20:]])
+        pv = ParagraphVectors(pairs, layer_size=32, window=3,
+                              min_word_frequency=3, iterations=10,
+                              learning_rate=0.05, seed=5).fit()
+        assert pv.label_vector("animals") is not None
+        assert (pv.similarity_to_label("cat", "animals")
+                > pv.similarity_to_label("cat", "royalty"))
+        assert pv.nearest_labels("queen")[0][0] == "royalty"
+
+
+class TestVectorizers:
+    def test_bag_of_words(self):
+        docs = ["the cat", "the dog", "cat cat"]
+        v = BagOfWordsVectorizer().fit(docs)
+        m = v.transform(docs)
+        assert m.shape == (3, v.vocab.num_words())
+        assert m[2, v.vocab.index_of("cat")] == 2.0
+
+    def test_tfidf_downweights_common_words(self):
+        docs = ["the cat", "the dog", "the bird"]
+        v = TfidfVectorizer().fit(docs)
+        m = v.transform(docs)
+        the_col = v.vocab.index_of("the")
+        cat_col = v.vocab.index_of("cat")
+        assert m[0, the_col] < m[0, cat_col]  # 'the' in all docs -> idf 0
+
+
+class TestWindows:
+    def test_window_padding_and_focus(self):
+        ws = windows(["a", "b", "c"], window_size=3)
+        assert len(ws) == 3
+        assert ws[0].words == ["<s>", "a", "b"]
+        assert ws[0].focus_word() == "a"
+        assert ws[2].words == ["b", "c", "</s>"]
+
+    def test_window_vector(self):
+        w2v = Word2Vec(toy_corpus(1), layer_size=8, iterations=1).fit()
+        ws = windows(["cat", "zebra"], window_size=3)
+        vec = window_as_vector(ws[0], w2v)
+        assert vec.shape == (3 * 8,)
