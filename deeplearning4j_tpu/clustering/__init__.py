@@ -1,0 +1,18 @@
+"""Clustering + spatial indexes.
+
+Parity: reference core/clustering/ — KMeans (kmeans/KMeansClustering.java
+over the BaseClusteringAlgorithm strategy machinery), KDTree
+(kdtree/KDTree.java), VPTree (vptree/VpTreeNode.java), QuadTree
+(quadtree/QuadTree.java — the Barnes-Hut t-SNE accelerator).
+
+TPU-native design: KMeans runs its Lloyd iterations as one jitted
+assign/update step (distance matrix on the MXU); the spatial indexes are
+host-side numpy structures — pointer-chasing trees don't belong on the
+accelerator, and their consumers (neighbor queries, Barnes-Hut) are
+host-side too.
+"""
+
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering  # noqa: F401
+from deeplearning4j_tpu.clustering.kdtree import KDTree  # noqa: F401
+from deeplearning4j_tpu.clustering.vptree import VPTree  # noqa: F401
+from deeplearning4j_tpu.clustering.quadtree import QuadTree  # noqa: F401

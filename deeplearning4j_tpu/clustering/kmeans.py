@@ -1,0 +1,85 @@
+"""KMeans clustering.
+
+Parity: reference core/clustering/kmeans/KMeansClustering.java (+ the
+strategy/condition machinery of clustering/algorithm/BaseClusteringAlgorithm:
+iterate until max iterations or distribution-variation convergence).
+
+TPU-native design: k-means++ seeding on the host, then each Lloyd
+iteration is ONE jitted step — the (n, k) distance matrix is a matmul on
+the MXU, assignment is an argmin, and the centroid update is a
+segment-sum. No per-point Java loops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class KMeansClustering:
+    def __init__(self, k: int, max_iterations: int = 100, tol: float = 1e-4,
+                 seed: int = 0):
+        self.k = k
+        self.max_iterations = max_iterations
+        self.tol = tol
+        self.seed = seed
+        self.centroids: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------- seeding
+    def _init_centroids(self, x: np.ndarray, rng: np.random.RandomState
+                        ) -> np.ndarray:
+        """k-means++ seeding."""
+        n = x.shape[0]
+        centroids = [x[rng.randint(n)]]
+        for _ in range(1, self.k):
+            d2 = np.min(
+                ((x[:, None, :] - np.stack(centroids)[None]) ** 2).sum(-1),
+                axis=1)
+            total = d2.sum()
+            if total <= 0:  # fewer distinct points than k: uniform fallback
+                centroids.append(x[rng.randint(n)])
+            else:
+                centroids.append(x[rng.choice(n, p=d2 / total)])
+        return np.stack(centroids)
+
+    # ------------------------------------------------------------ training
+    @staticmethod
+    @jax.jit
+    def _step(x, centroids):
+        # (n,k) squared distances via the expansion trick (MXU matmul)
+        x2 = jnp.sum(x * x, axis=1, keepdims=True)
+        c2 = jnp.sum(centroids * centroids, axis=1)[None, :]
+        d2 = x2 + c2 - 2.0 * (x @ centroids.T)
+        assign = jnp.argmin(d2, axis=1)
+        one_hot = jax.nn.one_hot(assign, centroids.shape[0], dtype=x.dtype)
+        counts = jnp.maximum(one_hot.sum(axis=0), 1.0)
+        new_centroids = (one_hot.T @ x) / counts[:, None]
+        # keep empty clusters where they were
+        empty = (one_hot.sum(axis=0) == 0)[:, None]
+        new_centroids = jnp.where(empty, centroids, new_centroids)
+        shift = jnp.max(jnp.linalg.norm(new_centroids - centroids, axis=1))
+        return new_centroids, assign, shift
+
+    def fit(self, x) -> "KMeansClustering":
+        x = np.asarray(x, np.float32)
+        if x.shape[0] < self.k:
+            raise ValueError(f"k={self.k} > n={x.shape[0]} points")
+        rng = np.random.RandomState(self.seed)
+        centroids = jnp.asarray(self._init_centroids(x, rng))
+        xj = jnp.asarray(x)
+        for _ in range(self.max_iterations):
+            centroids, assign, shift = self._step(xj, centroids)
+            if float(shift) < self.tol:
+                break
+        self.centroids = np.asarray(centroids)
+        return self
+
+    def predict(self, x) -> np.ndarray:
+        if self.centroids is None:
+            raise RuntimeError("call fit() first")
+        x = jnp.asarray(np.asarray(x, np.float32))
+        _, assign, _ = self._step(x, jnp.asarray(self.centroids))
+        return np.asarray(assign)

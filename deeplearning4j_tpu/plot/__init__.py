@@ -1,0 +1,14 @@
+"""Visualization: t-SNE, network plotters, render server.
+
+Parity: reference core/plot/ — `Tsne` (Tsne.java: gradient t-SNE with
+perplexity-searched affinities), `BarnesHutTsne` (BarnesHutTsne.java:
+quadtree-approximated O(n log n) gradient), `NeuralNetPlotter`
+(NeuralNetPlotter.java shells out to python/matplotlib scripts — here
+matplotlib is called directly, no Runtime.exec), `FilterRenderer` (weight
+grids) and the dropwizard coords server (nlp/plot/dropwizard/
+RenderApplication.java — here a stdlib http.server).
+"""
+
+from deeplearning4j_tpu.plot.tsne import BarnesHutTsne, Tsne  # noqa: F401
+from deeplearning4j_tpu.plot.plotter import NeuralNetPlotter  # noqa: F401
+from deeplearning4j_tpu.plot.render_server import serve_coords  # noqa: F401

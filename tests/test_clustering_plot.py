@@ -1,0 +1,154 @@
+"""Clustering + t-SNE + plotting tests (reference KMeans/KDTree/QuadTree/
+VPTree tests, TsneTest, BarnesHutTsneTest)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, KMeansClustering, QuadTree, VPTree
+from deeplearning4j_tpu.plot import BarnesHutTsne, NeuralNetPlotter, Tsne, serve_coords
+
+
+def two_blobs(n=60, seed=0):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(n // 2, 4) * 0.3 + np.array([3, 3, 3, 3])
+    b = rng.randn(n // 2, 4) * 0.3 - np.array([3, 3, 3, 3])
+    return np.vstack([a, b]).astype(np.float32)
+
+
+class TestKMeans:
+    def test_separates_blobs(self):
+        x = two_blobs()
+        km = KMeansClustering(k=2, seed=1).fit(x)
+        labels = km.predict(x)
+        first, second = labels[:30], labels[30:]
+        assert len(set(first.tolist())) == 1
+        assert len(set(second.tolist())) == 1
+        assert first[0] != second[0]
+
+    def test_k_larger_than_n_raises(self):
+        with pytest.raises(ValueError):
+            KMeansClustering(k=10).fit(np.zeros((3, 2)))
+
+
+class TestKDTree:
+    def test_knn_matches_bruteforce(self):
+        rng = np.random.RandomState(2)
+        pts = rng.randn(200, 3)
+        tree = KDTree.build(pts)
+        q = rng.randn(3)
+        res = tree.knn(q, 5)
+        brute = np.sort(np.linalg.norm(pts - q, axis=1))[:5]
+        np.testing.assert_allclose([d for d, _ in res], brute, rtol=1e-9)
+
+    def test_insert_and_nn(self):
+        tree = KDTree(2)
+        for p in [[0, 0], [1, 1], [2, 2]]:
+            tree.insert(p)
+        d, pt = tree.nn([0.9, 1.2])
+        np.testing.assert_allclose(pt, [1, 1])
+
+    def test_range_query(self):
+        pts = [[0, 0], [1, 1], [5, 5], [2, 2]]
+        tree = KDTree.build(pts)
+        inside = tree.range([0.5, 0.5], [2.5, 2.5])
+        assert sorted(tuple(p) for p in inside) == [(1, 1), (2, 2)]
+
+
+class TestVPTree:
+    def test_knn_matches_bruteforce(self):
+        rng = np.random.RandomState(3)
+        pts = rng.randn(150, 4)
+        tree = VPTree(pts)
+        q = rng.randn(4)
+        res = tree.knn(q, 4)
+        brute_idx = np.argsort(np.linalg.norm(pts - q, axis=1))[:4]
+        assert {i for _, i in res} == set(brute_idx.tolist())
+
+
+class TestQuadTree:
+    def test_insert_and_mass(self):
+        pts = np.array([[0.0, 0.0], [1.0, 1.0], [2.0, 0.0], [1.0, -1.0]])
+        tree = QuadTree(points=pts)
+        assert tree.cum_size == 4
+        np.testing.assert_allclose(tree.center_of_mass, pts.mean(0))
+
+    def test_barnes_hut_force_approximates_exact(self):
+        rng = np.random.RandomState(4)
+        pts = rng.randn(80, 2)
+        tree = QuadTree(points=pts)
+        q = pts[0]
+        neg_f = np.zeros(2)
+        z = tree.compute_non_edge_forces(q, theta=0.2, neg_f=neg_f)
+        # exact computation
+        diff = q[None] - pts[1:]
+        d2 = (diff ** 2).sum(1)
+        qij = 1.0 / (1.0 + d2)
+        z_exact = qij.sum()
+        f_exact = (qij[:, None] * qij[:, None] * diff).sum(0)
+        assert abs(z - z_exact) / z_exact < 0.05
+        np.testing.assert_allclose(neg_f, f_exact, rtol=0.15, atol=0.02)
+
+
+class TestTsne:
+    def test_exact_tsne_separates_blobs(self):
+        x = two_blobs(40)
+        y = Tsne(perplexity=10, n_iter=250, seed=0).calculate(x)
+        assert y.shape == (40, 2)
+        a, b = y[:20], y[20:]
+        centroid_dist = np.linalg.norm(a.mean(0) - b.mean(0))
+        spread = max(a.std(), b.std())
+        assert centroid_dist > 2 * spread  # clusters separate
+
+    def test_barnes_hut_tsne_separates_blobs(self):
+        x = two_blobs(40)
+        y = BarnesHutTsne(perplexity=10, n_iter=150, seed=0).calculate(x)
+        a, b = y[:20], y[20:]
+        assert np.linalg.norm(a.mean(0) - b.mean(0)) > max(a.std(), b.std())
+
+    def test_plot_writes_png(self, tmp_path):
+        x = two_blobs(20)
+        t = Tsne(perplexity=5, n_iter=50, seed=0)
+        path = t.plot(x, labels=[0] * 10 + [1] * 10,
+                      path=str(tmp_path / "t.png"))
+        assert (tmp_path / "t.png").stat().st_size > 0
+
+
+class TestPlotter:
+    def test_weight_histograms_and_activations(self, tmp_path):
+        from deeplearning4j_tpu.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.builder()
+                .n_in(4).activation_function("tanh")
+                .list(2).hidden_layer_sizes([6])
+                .override(1, layer="output", n_out=3,
+                          activation_function="softmax",
+                          loss_function="mcxent")
+                .pretrain(False).build())
+        net = MultiLayerNetwork(conf)
+        p = NeuralNetPlotter(out_dir=str(tmp_path))
+        h = p.plot_weight_histograms(net)
+        a = p.plot_activations(net, np.random.rand(8, 4).astype(np.float32))
+        f = p.render_filters(np.asarray(net.param_table["0"]["W"]),
+                             image_shape=(2, 2))
+        for path in (h, a, f):
+            assert (tmp_path / path.split("/")[-1]).stat().st_size > 0
+
+
+class TestRenderServer:
+    def test_serves_coords_json(self):
+        coords = np.array([[0.0, 1.0], [2.0, 3.0]])
+        server, port = serve_coords(coords, labels=["a", "b"])
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/api/coords", timeout=5) as r:
+                data = json.loads(r.read())
+            assert data["labels"] == ["a", "b"]
+            assert data["coords"] == [[0.0, 1.0], [2.0, 3.0]]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/", timeout=5) as r:
+                assert b"canvas" in r.read()
+        finally:
+            server.shutdown()
