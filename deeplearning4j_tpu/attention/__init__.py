@@ -1,0 +1,29 @@
+"""Long-context attention: blockwise (flash-style), Pallas kernel, and
+ring attention over a device mesh.
+
+The reference predates attention entirely (SURVEY §5: its long-sequence
+story is an unrolled LSTM + moving windows), so this package is the
+TPU-first capability the survey's charter adds: sequence/context
+parallelism that scales past one chip's HBM.
+
+Design:
+- `blockwise_attention` — online-softmax attention scanned over KV blocks
+  (the FlashAttention recurrence) in pure JAX; O(T) memory in sequence
+  length, differentiable, fuses under jit.
+- `flash_attention` — the same recurrence as a hand-tiled Pallas TPU
+  kernel (MXU-shaped 128-lane tiles, VMEM accumulators), with a
+  custom-VJP backward that recomputes via the blockwise form.
+- `ring_attention` — sequence-parallel attention inside shard_map: each
+  device holds a sequence shard of Q/K/V and K/V blocks rotate around the
+  mesh axis via `lax.ppermute` (ICI neighbor exchange) while every device
+  accumulates its queries' online softmax. Full attention over sequences
+  n_devices times longer than one chip could hold.
+"""
+
+from deeplearning4j_tpu.attention.blockwise import (  # noqa: F401
+    blockwise_attention,
+    naive_attention,
+)
+from deeplearning4j_tpu.attention.flash_pallas import flash_attention  # noqa: F401
+from deeplearning4j_tpu.attention.ring import ring_attention  # noqa: F401
+from deeplearning4j_tpu.attention.layer import SelfAttentionLayer  # noqa: F401

@@ -1,0 +1,145 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Forward: grid (batch*heads, Q tiles, KV blocks) — the TPU grid is
+sequential over the last dimension, so the kernel streams (block_k, d)
+K/V tiles through VMEM while float32 scratch accumulators carry the
+online-softmax state (acc, m, s) across KV steps for the current Q tile;
+the output tile is finalized on the last KV step. Causal tiles entirely
+above the diagonal are skipped (no MXU work). Backward: custom VJP that
+recomputes through the pure-JAX blockwise form (FlashAttention's standard
+recompute strategy — residuals are just q, k, v).
+
+Falls back to `blockwise_attention` for tile-indivisible shapes
+(interpret mode covers CPU tests).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.attention.blockwise import blockwise_attention
+
+NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, s_ref, *,
+            causal: bool, q_tile: int, block_k: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        s_ref[...] = jnp.zeros_like(s_ref)
+
+    # causal skip: this KV block starts after the last query of the tile
+    if causal:
+        skip = ki * block_k > (qi + 1) * q_tile - 1
+    else:
+        skip = jnp.asarray(False)
+
+    @pl.when(jnp.logical_not(skip))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # (q_tile, d)
+        k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
+        d = q.shape[-1]
+        scale = 1.0 / jnp.float32(d) ** 0.5
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * q_tile + jax.lax.broadcasted_iota(
+                jnp.int32, (q_tile, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (q_tile, block_k), 1)
+            mask = k_pos <= q_pos
+            scores = jnp.where(mask, scores, NEG_INF)
+        m_prev, s_prev = m_ref[...], s_ref[...]
+        m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        m_ref[...] = m_new
+        s_ref[...] = s_prev * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(s_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, q_tile: int, block_k: int,
+                   interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, t_q, d = q.shape
+    t_k = k.shape[1]
+    grid = (b, t_q // q_tile, t_k // block_k)
+    return pl.pallas_call(
+        partial(_kernel, causal=causal, q_tile=q_tile, block_k=block_k),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, q_tile, d), lambda bi, qi, ki: (bi, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda bi, qi, ki: (bi, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda bi, qi, ki: (bi, ki, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, q_tile, d),
+                               lambda bi, qi, ki: (bi, qi, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((q_tile, d), jnp.float32),   # acc
+            pltpu.VMEM((q_tile, 1), jnp.float32),   # running max
+            pltpu.VMEM((q_tile, 1), jnp.float32),   # running sum
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False, q_tile: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Pallas flash attention. q/k/v: (batch[*heads], T, d); T divisible
+    by the tile sizes (else falls back to blockwise). Set interpret=True
+    off-TPU."""
+    t_q, t_k = q.shape[1], k.shape[1]
+    if t_q % q_tile or t_k % block_k:
+        return blockwise_attention(q, k, v, causal=causal)
+    out = _flash_forward(q.reshape(-1, t_q, q.shape[-1]),
+                         k.reshape(-1, t_k, k.shape[-1]),
+                         v.reshape(-1, t_k, v.shape[-1]),
+                         causal, q_tile, block_k, interpret)
+    return out.reshape(q.shape)
+
+
+def _fwd(q, k, v, causal, q_tile, block_k, interpret):
+    return (flash_attention(q, k, v, causal, q_tile, block_k, interpret),
+            (q, k, v))
+
+
+def _bwd(causal, q_tile, block_k, interpret, res, g):
+    q, k, v = res
+    # FlashAttention recompute strategy: differentiate the blockwise form
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,54 @@
+"""Self-attention layer for the layer registry.
+
+Beyond-reference capability (the reference predates attention): a
+single-head self-attention block usable in a MultiLayerNetwork stack on
+(batch, T, d) inputs, computing through `blockwise_attention` so long
+sequences stay O(T) in memory. With a mesh configured, callers can swap
+the inner call for `ring_attention` (sequence parallelism).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.attention.blockwise import blockwise_attention
+from deeplearning4j_tpu.nn.layers import (BaseLayer, apply_dropout,
+                                          register_layer)
+
+
+@register_layer("self_attention")
+class SelfAttentionLayer(BaseLayer):
+    """Wq/Wk/Wv projections + flash-style attention + Wo output proj.
+    Config: n_in = model dim, n_out = head dim (defaults to n_in),
+    `causal` = causal masking. Params init through BaseLayer.init_params
+    (none are bias-named, so all four get the weight-init scheme)."""
+
+    def _dims(self):
+        d_model = self.conf.n_in
+        d_head = self.conf.n_out or d_model
+        return d_model, d_head
+
+    def is_causal(self) -> bool:
+        return bool(self.conf.causal)
+
+    def param_shapes(self) -> Dict[str, tuple]:
+        d_model, d_head = self._dims()
+        return {"Wq": (d_model, d_head), "Wk": (d_model, d_head),
+                "Wv": (d_model, d_head), "Wo": (d_head, d_model)}
+
+    def activate(self, params, x, *, rng: Optional[jax.Array] = None,
+                 training: bool = False):
+        """x: (B, T, d_model) -> (B, T, d_model)."""
+        if x.ndim != 3:
+            raise ValueError(
+                f"self_attention expects (batch, time, dim), got {x.shape}")
+        cd = jnp.dtype(self.conf.compute_dtype)
+        q = (x.astype(cd) @ params["Wq"].astype(cd))
+        k = (x.astype(cd) @ params["Wk"].astype(cd))
+        v = (x.astype(cd) @ params["Wv"].astype(cd))
+        out = blockwise_attention(q, k, v, causal=self.is_causal())
+        out = out.astype(jnp.dtype(self.conf.dtype)) @ params["Wo"]
+        return apply_dropout(rng, out, self.conf.dropout, training)

@@ -48,6 +48,8 @@ class NeuralNetConfiguration:
     sparsity: float = 0.0
     #: contrastive-divergence steps (RBM CD-k)
     k: int = 1
+    #: causal masking for attention layers (beyond-reference capability)
+    causal: bool = False
     # --- architecture ---
     layer: str = "dense"  # layer type name, resolved via nn.layers registry
     n_in: int = 0

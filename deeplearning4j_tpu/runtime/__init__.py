@@ -1,0 +1,17 @@
+"""Host-side native runtime (C++ via ctypes).
+
+Parity: the reference's native layer — ND4J's jblas/JNI backend and
+Canova's readers (SURVEY §2 [NATIVE-EQ]). TPU-native split: device math
+is XLA's; the native library owns host-side IO (IDX/CSV decoding) and
+the bounded producer/consumer queue used for input double-buffering.
+Every entry point has a pure-numpy fallback so the framework works
+without a toolchain; the native path is used when the shared library
+builds (g++, baked into the image).
+"""
+
+from deeplearning4j_tpu.runtime.native_loader import (  # noqa: F401
+    BatchQueue,
+    native_available,
+    read_csv,
+    read_idx,
+)

@@ -1,0 +1,137 @@
+"""Native runtime tests: C++ IDX/CSV readers vs numpy ground truth, and
+the bounded batch queue under producer/consumer threading."""
+
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.runtime import (
+    BatchQueue,
+    native_available,
+    read_csv,
+    read_idx,
+)
+from deeplearning4j_tpu.runtime.native_loader import _read_idx_numpy
+
+
+def write_idx3(path, arr: np.ndarray):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">BBBB", 0, 0, 0x08, arr.ndim))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+class TestNativeBuild:
+    def test_builds_on_this_image(self):
+        # g++ is baked into the image; the native path must be live here
+        assert native_available()
+
+
+class TestIdxReader:
+    def test_matches_numpy_reader(self, tmp_path):
+        rng = np.random.RandomState(0)
+        arr = rng.randint(0, 256, (10, 7, 5), np.uint8)
+        p = str(tmp_path / "images.idx3")
+        write_idx3(p, arr)
+        out = read_idx(p)
+        np.testing.assert_array_equal(out, arr)
+        np.testing.assert_array_equal(_read_idx_numpy(p), arr)
+
+    def test_labels_1d(self, tmp_path):
+        arr = np.arange(9, dtype=np.uint8)
+        p = str(tmp_path / "labels.idx1")
+        write_idx3(p, arr)
+        np.testing.assert_array_equal(read_idx(p), arr)
+
+    def test_bad_magic_rejected(self, tmp_path):
+        p = tmp_path / "bad.idx"
+        p.write_bytes(b"\x01\x02\x03\x04garbage")
+        with pytest.raises(ValueError):
+            read_idx(str(p))
+
+    def test_truncated_rejected(self, tmp_path):
+        arr = np.ones((4, 4), np.uint8)
+        p = str(tmp_path / "trunc.idx")
+        write_idx3(p, arr)
+        with open(p, "r+b") as f:
+            f.truncate(14)  # cut into the payload
+        with pytest.raises(ValueError):
+            read_idx(p)
+
+
+class TestCsvReader:
+    def test_matches_loadtxt(self, tmp_path):
+        rng = np.random.RandomState(1)
+        data = rng.randn(50, 6).astype(np.float32)
+        p = str(tmp_path / "data.csv")
+        np.savetxt(p, data, delimiter=",", fmt="%.6f")
+        out = read_csv(p)
+        ref = np.loadtxt(p, delimiter=",", dtype=np.float32, ndmin=2)
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_no_trailing_newline(self, tmp_path):
+        p = tmp_path / "x.csv"
+        p.write_text("1.0,2.0\n3.0,4.0")
+        out = read_csv(str(p))
+        np.testing.assert_allclose(out, [[1, 2], [3, 4]])
+
+    def test_ragged_rejected(self, tmp_path):
+        p = tmp_path / "ragged.csv"
+        p.write_text("1,2,3\n4,5\n")
+        with pytest.raises(ValueError):
+            read_csv(str(p))
+
+
+class TestBatchQueue:
+    def test_fifo_round_trip(self):
+        q = BatchQueue(capacity=4)
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        b = np.ones((2, 2, 2), np.float32)
+        assert q.push(a) and q.push(b)
+        np.testing.assert_array_equal(q.pop(), a)
+        np.testing.assert_array_equal(q.pop(), b)
+        q.close()
+        assert q.pop() is None
+
+    def test_producer_consumer_threads(self):
+        q = BatchQueue(capacity=2)  # small: forces backpressure
+        n = 50
+        sent = [np.full((8, 8), i, np.float32) for i in range(n)]
+        received = []
+
+        def produce():
+            for arr in sent:
+                q.push(arr)
+            q.close()
+
+        def consume():
+            while True:
+                item = q.pop()
+                if item is None:
+                    break
+                received.append(item)
+
+        tp = threading.Thread(target=produce)
+        tc = threading.Thread(target=consume)
+        tp.start(); tc.start()
+        tp.join(timeout=30); tc.join(timeout=30)
+        assert len(received) == n
+        for i, arr in enumerate(received):
+            assert float(arr[0, 0]) == i  # order preserved
+
+    def test_close_unblocks_consumer(self):
+        q = BatchQueue(capacity=2)
+        result = {}
+
+        def consume():
+            result["item"] = q.pop()
+
+        t = threading.Thread(target=consume)
+        t.start()
+        q.close()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert result["item"] is None
