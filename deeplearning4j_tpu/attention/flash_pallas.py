@@ -26,7 +26,7 @@ NEG_INF = -1e30
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, s_ref, *,
-            causal: bool, q_tile: int, block_k: int):
+            causal: bool, q_tile: int, block_k: int, causal_offset: int):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -39,9 +39,12 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, s_ref, *,
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         s_ref[...] = jnp.zeros_like(s_ref)
 
-    # causal skip: this KV block starts after the last query of the tile
+    # causal skip: this KV block starts after the last key visible to the
+    # tile's last query (bottom-right alignment: query i sees keys up to
+    # i + causal_offset, causal_offset = Tk - Tq — matches blockwise;
+    # fully-masked rows output 0 like blockwise, unlike naive's mean-of-V).
     if causal:
-        skip = ki * block_k > (qi + 1) * q_tile - 1
+        skip = ki * block_k > (qi + 1) * q_tile - 1 + causal_offset
     else:
         skip = jnp.asarray(False)
 
@@ -60,7 +63,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, s_ref, *,
                 jnp.int32, (q_tile, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (q_tile, block_k), 1)
-            mask = k_pos <= q_pos
+            mask = k_pos <= q_pos + causal_offset
             scores = jnp.where(mask, scores, NEG_INF)
         m_prev, s_prev = m_ref[...], s_ref[...]
         m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
@@ -89,7 +92,8 @@ def _flash_forward(q, k, v, causal: bool, q_tile: int, block_k: int,
     t_k = k.shape[1]
     grid = (b, t_q // q_tile, t_k // block_k)
     return pl.pallas_call(
-        partial(_kernel, causal=causal, q_tile=q_tile, block_k=block_k),
+        partial(_kernel, causal=causal, q_tile=q_tile, block_k=block_k,
+                causal_offset=t_k - t_q),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         grid=grid,
         in_specs=[

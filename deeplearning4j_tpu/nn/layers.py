@@ -47,7 +47,10 @@ def apply_dropout(rng: Optional[jax.Array], x, rate: float,
 def make_layer(conf) -> "BaseLayer":
     """Resolve conf.layer through the registry (LayerFactories parity)."""
     if conf.layer.lower() not in LAYER_REGISTRY:
+        # Layer providers register on import; pull them all in so configs
+        # restored in a fresh process (CLI, scaleout performers) resolve.
         import deeplearning4j_tpu.models  # noqa: F401  registers model layers
+        import deeplearning4j_tpu.attention  # noqa: F401  self_attention
     try:
         cls = LAYER_REGISTRY[conf.layer.lower()]
     except KeyError:

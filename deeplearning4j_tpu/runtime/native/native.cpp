@@ -82,7 +82,7 @@ int dl4j_csv_read(const char* path, char delimiter, float** data_out,
   while (p < end) {
     char* next = nullptr;
     const float v = std::strtof(p, &next);
-    if (next == p) {  // no parse: skip one char (handles stray text)
+    if (next == p) {  // no parse
       if (*p == '\n') {
         if (cur_cols > 0) {
           if (cols < 0) cols = cur_cols;
@@ -90,9 +90,15 @@ int dl4j_csv_read(const char* path, char delimiter, float** data_out,
           rows++;
           cur_cols = 0;
         }
+        p++;
+        continue;
       }
-      p++;
-      continue;
+      if (*p == '\r' || *p == ' ' || *p == '\t' || *p == delimiter) {
+        p++;
+        continue;
+      }
+      return -6;  // unparsable text (e.g. header row) — match numpy, which
+                  // raises on the same input rather than dropping it
     }
     values.push_back(v);
     cur_cols++;

@@ -166,6 +166,10 @@ class BatchQueue:
     @staticmethod
     def _pack(arr: np.ndarray) -> np.ndarray:
         arr = np.ascontiguousarray(arr, np.float32)
+        if arr.ndim > 4:
+            raise ValueError(
+                f"BatchQueue supports ndim <= 4, got ndim={arr.ndim} "
+                "(the 5-int64 wire header carries at most 4 dims)")
         header = np.array([arr.ndim, *arr.shape, *([0] * (4 - arr.ndim))],
                           np.int64)
         return np.concatenate([header.view(np.uint8),

@@ -18,7 +18,6 @@ packed parameter vectors between host threads/processes.
 from __future__ import annotations
 
 import os
-import pickle
 import tempfile
 import threading
 from dataclasses import dataclass, field
@@ -247,12 +246,12 @@ class LocalFileUpdateSaver(UpdateSaver):
 
     def _path(self, worker_id: str) -> str:
         safe = worker_id.replace(os.sep, "_")
-        return os.path.join(self.dir, f"{safe}.update.pkl")
+        return os.path.join(self.dir, f"{safe}.update.npy")
 
     def save(self, worker_id, update):
         with self._lock:
             with open(self._path(worker_id), "wb") as f:
-                pickle.dump(np.asarray(update), f)
+                np.save(f, np.asarray(update), allow_pickle=False)
 
     def load(self, worker_id):
         path = self._path(worker_id)
@@ -260,12 +259,12 @@ class LocalFileUpdateSaver(UpdateSaver):
             return None
         with self._lock:
             with open(path, "rb") as f:
-                return pickle.load(f)
+                return np.load(f, allow_pickle=False)
 
     def keys(self):
         with self._lock:
-            return [f[:-len(".update.pkl")] for f in os.listdir(self.dir)
-                    if f.endswith(".update.pkl")]
+            return [f[:-len(".update.npy")] for f in os.listdir(self.dir)
+                    if f.endswith(".update.npy")]
 
     def delete(self, worker_id):
         path = self._path(worker_id)

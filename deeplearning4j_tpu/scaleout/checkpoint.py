@@ -9,20 +9,95 @@ vector). The reference never checkpoints optimizer state or data position
 (conf_json, packed params, updater state pytree, data-iterator position,
 user metadata), which makes distributed resume deterministic.
 
-Format: a single file holding a pickled dict of numpy arrays + JSON strings.
-(On a real pod this file lands on GCS; the writer below only assumes a
-filesystem path. An orbax-backed saver can implement the same two calls.)
+Format: a single `.npz` file — arrays stored as plain npy members plus a
+JSON manifest describing the pytree structure. Nothing is unpickled on
+load (`allow_pickle=False`), so loading a checkpoint from a shared/cloud
+path is safe: worst case is a ValueError, never code execution. (On a real
+pod this file lands on GCS; the writer below only assumes a filesystem
+path. An orbax-backed saver can implement the same two calls.)
 """
 
 from __future__ import annotations
 
+import io
+import json
 import os
-import pickle
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
+
+from deeplearning4j_tpu.optimize.updater import UpdaterState
+
+#: NamedTuple node types that may appear in checkpointed pytrees.
+_NAMEDTUPLES = {"UpdaterState": UpdaterState}
+
+
+def _encode_tree(obj, arrays: Dict[str, np.ndarray]):
+    """Encode a pytree of arrays/scalars/containers into a JSON-able
+    manifest, moving every array leaf into `arrays` under a fresh key."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, (np.ndarray, np.generic, jax.Array)):
+        key = f"a{len(arrays)}"
+        arrays[key] = np.asarray(obj)
+        return {"__arr__": key}
+    if hasattr(obj, "_fields"):  # NamedTuple
+        name = type(obj).__name__
+        if name not in _NAMEDTUPLES:
+            raise TypeError(f"Unregistered NamedTuple in checkpoint: {name}")
+        return {"__nt__": name,
+                "fields": {f: _encode_tree(getattr(obj, f), arrays)
+                           for f in obj._fields}}
+    if isinstance(obj, dict):
+        for k in obj:
+            if not isinstance(k, str):
+                raise TypeError(
+                    f"Checkpoint dict keys must be str, got {k!r} "
+                    f"({type(k).__name__}) — JSON round-trip would rekey it")
+        return {"__dict__": {k: _encode_tree(v, arrays)
+                             for k, v in obj.items()}}
+    if isinstance(obj, tuple):
+        return {"__tuple__": [_encode_tree(v, arrays) for v in obj]}
+    if isinstance(obj, list):
+        return {"__list__": [_encode_tree(v, arrays) for v in obj]}
+    raise TypeError(f"Cannot checkpoint object of type {type(obj)!r}")
+
+
+def _decode_tree(node, arrays):
+    if not isinstance(node, dict):
+        return node
+    if "__arr__" in node:
+        return arrays[node["__arr__"]]
+    if "__nt__" in node:
+        cls = _NAMEDTUPLES[node["__nt__"]]
+        return cls(**{f: _decode_tree(v, arrays)
+                      for f, v in node["fields"].items()})
+    if "__dict__" in node:
+        return {k: _decode_tree(v, arrays) for k, v in node["__dict__"].items()}
+    if "__tuple__" in node:
+        return tuple(_decode_tree(v, arrays) for v in node["__tuple__"])
+    if "__list__" in node:
+        return [_decode_tree(v, arrays) for v in node["__list__"]]
+    raise ValueError(f"Malformed checkpoint manifest node: {node!r}")
+
+
+def dump_payload(payload: Dict[str, Any]) -> bytes:
+    """Serialize a checkpoint payload dict to npz bytes (no pickle)."""
+    arrays: Dict[str, np.ndarray] = {}
+    manifest = _encode_tree(payload, arrays)
+    buf = io.BytesIO()
+    np.savez(buf, __manifest__=np.frombuffer(
+        json.dumps(manifest).encode(), np.uint8), **arrays)
+    return buf.getvalue()
+
+
+def load_payload(data: bytes) -> Dict[str, Any]:
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
+        manifest = json.loads(bytes(z["__manifest__"]).decode())
+        arrays = {k: z[k] for k in z.files if k != "__manifest__"}
+    return _decode_tree(manifest, arrays)
 
 
 def _to_numpy_tree(tree):
@@ -48,7 +123,7 @@ class DefaultModelSaver(ModelSaver):
             os.replace(self.path, f"{self.path}.{int(time.time() * 1000)}")
         tmp = f"{self.path}.tmp"
         with open(tmp, "wb") as f:
-            pickle.dump(payload, f)
+            f.write(dump_payload(payload))
         os.replace(tmp, self.path)
         return self.path
 
@@ -56,7 +131,7 @@ class DefaultModelSaver(ModelSaver):
     def _payload(*, conf_json, params, updater_state=None,
                  iteration_count=0, iterator_position=None, metadata=None):
         return {
-            "format_version": 1,
+            "format_version": 2,
             "conf_json": conf_json,
             "params": np.asarray(params),
             "updater_state": updater_state,
@@ -96,7 +171,15 @@ def load_checkpoint(path: str):
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
     with open(path, "rb") as f:
-        payload = pickle.load(f)
+        data = f.read()
+    if data[:2] == b"\x80\x04" or not data.startswith(b"PK"):
+        raise ValueError(
+            f"Checkpoint {path} is not in the npz format (format_version 2). "
+            "v1 checkpoints were pickle streams, which are no longer loaded "
+            "(arbitrary-code-execution risk on shared paths); re-save from "
+            "the run that produced it, or convert offline with a trusted "
+            "pickle.load + DefaultModelSaver.")
+    payload = load_payload(data)
     if payload.get("conf_json") is None:
         raise ValueError(
             f"Checkpoint {path} has no conf_json (params-only runtime "

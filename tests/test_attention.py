@@ -78,6 +78,31 @@ class TestFlashPallas:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5)
 
+    def test_causal_cross_attention_matches_blockwise(self):
+        # Tq != Tk causal: kernel must use the same bottom-right alignment
+        # as the blockwise/naive paths (query i sees keys up to i + Tk - Tq)
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = jax.random.normal(ks[0], (2, 128, 16))
+        k = jax.random.normal(ks[1], (2, 256, 16))
+        v = jax.random.normal(ks[2], (2, 256, 16))
+        ref = naive_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, True, 128, 128, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_causal_tq_gt_tk_matches_blockwise(self):
+        # Tq > Tk: the first Tq - Tk query rows are fully masked; both the
+        # kernel and blockwise output 0 for them (naive would give mean-V)
+        ks = jax.random.split(jax.random.PRNGKey(4), 3)
+        q = jax.random.normal(ks[0], (1, 256, 16))
+        k = jax.random.normal(ks[1], (1, 128, 16))
+        v = jax.random.normal(ks[2], (1, 128, 16))
+        ref = blockwise_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, True, 128, 128, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+        np.testing.assert_allclose(np.asarray(out)[0, :128], 0.0, atol=1e-6)
+
     def test_fallback_on_ragged_shapes(self):
         q, k, v = qkv(t=60)  # not divisible -> blockwise fallback
         ref = naive_attention(q, k, v)
@@ -143,6 +168,31 @@ class TestRing:
 
 
 class TestSelfAttentionLayer:
+    def test_resolves_in_fresh_registry(self):
+        # Simulates a fresh process (CLI test/predict restoring an
+        # attention checkpoint): the registry has no attention entries
+        # until make_layer imports the providing package.
+        import sys
+
+        from deeplearning4j_tpu.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import LAYER_REGISTRY, make_layer
+
+        saved_reg = dict(LAYER_REGISTRY)
+        saved_mods = {k: sys.modules.pop(k) for k in list(sys.modules)
+                      if k.startswith("deeplearning4j_tpu.attention")}
+        LAYER_REGISTRY.pop("self_attention", None)
+        try:
+            c = NeuralNetConfiguration()
+            c.layer = "self_attention"
+            c.n_in = 16
+            c.n_out = 16
+            layer = make_layer(c)
+            assert type(layer).__name__ == "SelfAttentionLayer"
+        finally:
+            sys.modules.update(saved_mods)
+            LAYER_REGISTRY.clear()
+            LAYER_REGISTRY.update(saved_reg)
+
     def test_registered_and_trains(self):
         from deeplearning4j_tpu.config import NeuralNetConfiguration
         from deeplearning4j_tpu.nn.layers import make_layer

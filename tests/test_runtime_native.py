@@ -84,8 +84,20 @@ class TestCsvReader:
         with pytest.raises(ValueError):
             read_csv(str(p))
 
+    def test_header_row_rejected(self, tmp_path):
+        # native parser and numpy fallback must agree: unparsable text is
+        # an error, not silently dropped
+        p = tmp_path / "header.csv"
+        p.write_text("a,b,label\n1,2,3\n")
+        with pytest.raises(ValueError):
+            read_csv(str(p))
+
 
 class TestBatchQueue:
+    def test_ndim_over_4_rejected(self):
+        with pytest.raises(ValueError):
+            BatchQueue._pack(np.zeros((1, 1, 1, 1, 1), np.float32))
+
     def test_fifo_round_trip(self):
         q = BatchQueue(capacity=4)
         a = np.arange(12, dtype=np.float32).reshape(3, 4)
