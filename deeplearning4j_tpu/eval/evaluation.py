@@ -27,10 +27,7 @@ class Evaluation:
         n_classes = self.num_classes or truth.shape[-1]
         if self.confusion is None:
             self.confusion = ConfusionMatrix(list(range(n_classes)))
-        actual = truth.argmax(-1)
-        predicted = guess.argmax(-1)
-        for a, p in zip(actual, predicted):
-            self.confusion.add(int(a), int(p))
+        self.confusion.add_batch(truth.argmax(-1), guess.argmax(-1))
 
     # ------------------------------------------------------------ metrics
     def _tp(self, c: int) -> int:

@@ -257,11 +257,20 @@ class MultiLayerNetwork:
             self._train_step = step
         return self._train_step
 
-    def finetune(self, x, labels) -> None:
+    def finetune(self, x, labels=None) -> None:
         """Optimize only the output layer on top of frozen features
-        (reference finetune :1044/:1079 -> OutputLayer.fit)."""
-        acts = self.feed_forward_fn(self._params, jnp.asarray(x))
-        hidden = acts[-2] if len(acts) >= 2 else jnp.asarray(x)
+        (reference finetune :1044/:1079 -> OutputLayer.fit). Accepts
+        (x, labels) arrays or a DataSetIterator; large arrays stream the
+        frozen-feature computation in batch_size chunks rather than
+        feed-forwarding the whole dataset in one device batch."""
+        if labels is None:  # iterator protocol
+            iterator = x
+            iterator.reset()
+            for ds in iterator:
+                self.finetune(ds.features, ds.labels)
+            return
+        x = jnp.asarray(x)
+        hidden = self._frozen_features(x)
         out_idx = str(len(self.layers) - 1)
         out_layer = self.layers[-1]
         flat0, unravel = ravel_pytree(self._params[out_idx])
@@ -273,6 +282,17 @@ class MultiLayerNetwork:
                         model=self)
         new_params, _ = solver.optimize(self._params[out_idx])
         self._params[out_idx] = new_params
+
+    def _frozen_features(self, x, chunk_size: int = 4096) -> jnp.ndarray:
+        """Features under the output layer, computed in chunks so only
+        (chunk, features) activations are ever live on device."""
+        if len(self.layers) < 2:
+            return x
+        if x.shape[0] <= chunk_size:
+            return self.feed_forward_fn(self._params, x)[-2]
+        outs = [self.feed_forward_fn(self._params, x[i:i + chunk_size])[-2]
+                for i in range(0, x.shape[0], chunk_size)]
+        return jnp.concatenate(outs, axis=0)
 
     # ----------------------------------------------------------- inference
     def feed_forward(self, x) -> List[jnp.ndarray]:
