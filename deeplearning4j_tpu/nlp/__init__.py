@@ -37,3 +37,9 @@ from deeplearning4j_tpu.nlp.vectorizers import (  # noqa: F401
     BagOfWordsVectorizer,
     TfidfVectorizer,
 )
+from deeplearning4j_tpu.nlp.tree import (  # noqa: F401
+    Tree,
+    binarize,
+    parse_tree,
+)
+from deeplearning4j_tpu.nlp.rntn import RNTN  # noqa: F401

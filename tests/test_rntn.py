@@ -1,0 +1,204 @@
+"""RNTN tests (reference BasicRNTNTest + the RNTN.java contract: training
+on labeled trees reduces loss; forwardPropagateTree annotates every
+internal node with vector/prediction/error)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import RNTN, Tree, binarize, parse_tree
+
+
+def sentiment_trees():
+    """Tiny synthetic sentiment corpus: class 0 = negative, 1 = positive.
+    Node labels follow the Stanford Sentiment Treebank convention (every
+    node labeled)."""
+    texts = [
+        "(0 (0 (0 bad) (0 movie)) (0 (0 truly) (0 awful)))",
+        "(1 (1 (1 good) (1 movie)) (1 (1 truly) (1 great)))",
+        "(0 (0 (0 awful) (0 film)) (0 (0 very) (0 bad)))",
+        "(1 (1 (1 great) (1 film)) (1 (1 very) (1 good)))",
+        "(0 (0 (0 boring) (0 plot)) (0 (0 bad) (0 acting)))",
+        "(1 (1 (1 brilliant) (1 plot)) (1 (1 good) (1 acting)))",
+    ]
+    return [parse_tree(t) for t in texts]
+
+
+class TestTree:
+    def test_parse_round_trip(self):
+        t = parse_tree("(2 (1 bad) (3 movie))")
+        assert t.gold_label == 2
+        assert [c.gold_label for c in t.children] == [1, 3]
+        assert t.tokens() == ["bad", "movie"]
+        assert t.children[0].is_preterminal()
+        assert not t.is_leaf() and t.depth() == 2
+        assert t.to_sexpr() == "(2 (1 bad) (3 movie))"
+
+    def test_category_labels(self):
+        t = parse_tree("(S (NP (DT the) (NN cat)) (VP (VB sat)))")
+        assert t.label == "S"
+        assert t.children[0].label == "NP"
+        assert t.tokens() == ["the", "cat", "sat"]
+
+    def test_clone_independent(self):
+        t = parse_tree("(1 (1 a) (1 b))")
+        c = t.clone()
+        c.children[0].gold_label = 0
+        assert t.children[0].gold_label == 1
+
+    def test_binarize_nary(self):
+        t = parse_tree("(1 (1 a) (1 b) (1 c))")
+        b = binarize(t)
+        assert len(b.children) == 2
+        assert b.tokens() == ["a", "b", "c"]
+
+    def test_binarize_collapses_unary_chain(self):
+        t = parse_tree("(2 (1 (0 word)))")
+        b = binarize(t)
+        assert b.is_preterminal()
+        assert b.gold_label == 0  # innermost label kept
+
+    def test_error_sum(self):
+        t = parse_tree("(1 (1 a) (1 b))")
+        t.error = 1.0
+        t.children[0].error = 0.5
+        assert t.error_sum() == pytest.approx(1.5)
+
+
+class TestRNTN:
+    def test_training_reduces_loss(self):
+        trees = sentiment_trees()
+        model = RNTN(num_hidden=8, num_outs=2, lr=0.1, seed=0)
+        first = model.fit(trees, epochs=1)
+        final = model.fit(trees, epochs=30)
+        assert final < first
+
+    def test_predicts_above_chance(self):
+        trees = sentiment_trees()
+        model = RNTN(num_hidden=8, num_outs=2, lr=0.1, seed=0)
+        model.fit(trees, epochs=60)
+        preds = [model.predict(t) for t in trees]
+        gold = [t.gold_label for t in trees]
+        acc = np.mean([p == g for p, g in zip(preds, gold)])
+        assert acc >= 0.8  # 6 trees, chance = 0.5
+
+    def test_forward_propagate_annotates_nodes(self):
+        trees = sentiment_trees()
+        model = RNTN(num_hidden=8, num_outs=2, seed=0)
+        model.fit(trees, epochs=1)
+        t = trees[0]
+        model.forward_propagate_tree(t)
+
+        def check(node):
+            if node.is_leaf():
+                assert node.vector is None
+                return
+            assert node.vector.shape == (8,)
+            assert node.prediction.shape == (2,)
+            assert np.isclose(node.prediction.sum(), 1.0, atol=1e-5)
+            assert node.error >= 0
+            for c in node.children:
+                check(c)
+
+        check(t)
+        assert t.error_sum() > 0
+
+    def test_no_tensors_mode(self):
+        trees = sentiment_trees()
+        model = RNTN(num_hidden=6, num_outs=2, use_tensors=False, lr=0.1,
+                     seed=0)
+        first = model.fit(trees, epochs=1)
+        final = model.fit(trees, epochs=30)
+        assert final < first
+        assert "T" not in model.params()
+
+    def test_per_category_model(self):
+        # non-simplified: parameters stacked per category pair
+        texts = [
+            "(S (NP (DT the) (NN cat)) (VP (VB sat)))",
+            "(S (NP (DT a) (NN dog)) (VP (VB ran)))",
+        ]
+        trees = [parse_tree(t) for t in texts]
+        for t in trees:
+            t.gold_label = 1
+        trees = [binarize(t) for t in trees]
+        model = RNTN(num_hidden=6, num_outs=2, simplified_model=False,
+                     combine_classification=False, lr=0.1, seed=0)
+        model.fit(trees, epochs=5)
+        assert len(model.cat_index) >= 2
+        assert model.params()["W"].shape[0] == len(model.cat_index)
+        assert "Wb" in model.params()
+
+    def test_unlabeled_nodes_ignored(self):
+        t = parse_tree("(1 (-1 (1 good) (1 show)) (1 (1 very) (1 fun)))")
+        model = RNTN(num_hidden=6, num_outs=2, lr=0.1, seed=0)
+        loss = model.fit([t], epochs=10)
+        assert np.isfinite(loss)
+
+    def test_builder_surface(self):
+        model = (RNTN.builder().num_hidden(10).num_outs(4)
+                 .use_tensors(False).lr(0.05).build())
+        assert model.num_hidden == 10 and model.num_outs == 4
+        assert model.use_tensors is False
+
+    def test_class_weights_applied(self):
+        trees = sentiment_trees()
+        m1 = RNTN(num_hidden=6, num_outs=2, seed=0)
+        m2 = RNTN(num_hidden=6, num_outs=2, seed=0,
+                  class_weights={0: 10.0})
+        l1 = m1.fit(trees, epochs=1)
+        l2 = m2.fit(trees, epochs=1)
+        assert l2 > l1  # upweighted class-0 errors dominate
+
+    def test_feature_vector_init(self):
+        trees = sentiment_trees()
+        fv = {"bad": np.ones(8, np.float32), "good": -np.ones(8, np.float32)}
+        model = RNTN(num_hidden=8, num_outs=2, feature_vectors=fv, seed=0)
+        model.fit(trees, epochs=1)
+        e = np.asarray(model.params()["E"])
+        # initialized rows survived into E (training moved them slightly)
+        assert np.allclose(e[model.word_index["bad"]], 1.0, atol=0.1)
+
+    def test_unknown_word_maps_to_unk(self):
+        trees = sentiment_trees()
+        model = RNTN(num_hidden=6, num_outs=2, seed=0)
+        model.fit(trees, epochs=2)
+        unseen = parse_tree("(1 (1 zzz) (1 qqq))")
+        pred = model.predict(unseen)  # must not raise
+        assert pred in (0, 1)
+
+    def test_lowercase_feature_names(self):
+        model = RNTN(num_hidden=6, num_outs=2, seed=0,
+                     lower_case_feature_names=True)
+        model.fit([parse_tree("(1 (1 Good) (0 Bad))")], epochs=1)
+        enc = model.encode([parse_tree("(1 (1 good) (0 BAD))")])
+        # mixed-case tokens resolve to the same (non-UNK) vocab rows
+        words = enc.word[0][enc.kind[0] == 1]
+        assert set(words) == {model.word_index["good"],
+                              model.word_index["bad"]}
+        assert 0 not in words  # nothing fell back to UNK
+
+    def test_refit_with_new_words_grows_embeddings(self):
+        model = RNTN(num_hidden=6, num_outs=2, lr=0.1, seed=0)
+        model.fit([parse_tree("(1 (1 aa) (0 bb))")], epochs=2)
+        v1 = model.params()["E"].shape[0]
+        model.fit([parse_tree("(0 (1 cc) (0 dd))")], epochs=2)
+        assert model.params()["E"].shape[0] == v1 + 2
+        enc = model.encode([parse_tree("(0 (1 cc) (0 dd))")])
+        assert enc.word.max() == model.params()["E"].shape[0] - 1
+
+    def test_batched_output_matches_predict(self):
+        trees = sentiment_trees()
+        model = RNTN(num_hidden=6, num_outs=2, lr=0.1, seed=0)
+        model.fit(trees, epochs=20)
+        probs = model.output(trees)
+        assert probs.shape == (len(trees), 2)
+        for row, t in zip(probs, trees):
+            assert int(np.argmax(row)) == model.predict(t)
+
+    def test_binarize_does_not_mutate_input(self):
+        t = parse_tree("(2 (-1 (-1 word)))")
+        b = binarize(t)
+        assert t.children[0].gold_label == -1  # input untouched
+        assert t.children[0].children[0].gold_label == -1
+        assert b.gold_label == 2  # unlabeled collapsed chain takes outer
+        assert b is not t.children[0]
