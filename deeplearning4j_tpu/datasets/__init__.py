@@ -2,10 +2,35 @@ from deeplearning4j_tpu.datasets.api import (  # noqa: F401
     DataSet,
     DataSetIterator,
     ListDataSetIterator,
-    SamplingDataSetIterator,
     MultipleEpochsIterator,
+    ReconstructionDataSetIterator,
+    SamplingDataSetIterator,
     TestDataSetIterator,
 )
-from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.mnist import (  # noqa: F401
+    MnistDataSetIterator,
+    RawMnistDataSetIterator,
+)
 from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.csv import CSVDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.records import (  # noqa: F401
+    CSVRecordReader,
+    ImageRecordReader,
+    LineRecordReader,
+    ListRecordReader,
+    RecordReader,
+    RecordReaderDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.lfw import (  # noqa: F401
+    LFWDataFetcher,
+    LFWDataSetIterator,
+    LFWLoader,
+    synthetic_lfw,
+)
+from deeplearning4j_tpu.datasets.curves import (  # noqa: F401
+    CurvesDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.moving_window import (  # noqa: F401
+    MovingWindowDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.vectorizer import ImageVectorizer  # noqa: F401

@@ -1,79 +1,28 @@
 """CSV dataset iterator.
 
-Parity: reference core/datasets/fetchers CSV path + `CSVDataSetIterator` and
-the Canova record-reader bridge (core/datasets/canova/
-RecordReaderDataSetIterator.java) — here a `RecordReader` is any iterable of
-value lists; `CSVRecordReader` parses delimited text files.
+Parity: reference `CSVDataSetIterator` (core/datasets/fetchers CSV path).
+Built on the pluggable record-reader protocol in datasets/records.py
+(CSVRecordReader is re-exported from there for back-compat).
 """
 
 from __future__ import annotations
 
-from typing import Iterable, List, Optional, Sequence
+from typing import Optional
 
-import numpy as np
-
-from deeplearning4j_tpu.datasets.api import DataSet, DataSetIterator
-
-
-class CSVRecordReader:
-    """Minimal Canova-style record reader over a delimited text file."""
-
-    def __init__(self, path: str, delimiter: str = ",", skip_lines: int = 0):
-        self.path = path
-        self.delimiter = delimiter
-        self.skip_lines = skip_lines
-
-    def records(self) -> Iterable[List[str]]:
-        with open(self.path) as f:
-            for i, line in enumerate(f):
-                if i < self.skip_lines:
-                    continue
-                line = line.strip()
-                if line:
-                    yield line.split(self.delimiter)
-
-
-class RecordReaderDataSetIterator(DataSetIterator):
-    """Bridge record reader -> DataSet batches (reference
-    RecordReaderDataSetIterator.java). `label_index` column becomes a one-hot
-    label over `num_classes`; remaining columns are features. With
-    label_index=None the features are also the labels (reconstruction)."""
-
-    def __init__(self, reader, batch_size: int,
-                 label_index: Optional[int] = -1,
-                 num_classes: Optional[int] = None):
-        records = [[float(v) for v in rec] for rec in reader.records()]
-        arr = np.asarray(records, np.float32)
-        if label_index is not None and not num_classes:
-            raise ValueError(
-                "label_index given without num_classes; pass num_classes for "
-                "classification or label_index=None for reconstruction")
-        if label_index is not None and num_classes:
-            li = label_index if label_index >= 0 else arr.shape[1] - 1
-            raw = arr[:, li].astype(int)
-            features = np.delete(arr, li, axis=1)
-            labels = np.zeros((arr.shape[0], num_classes), np.float32)
-            labels[np.arange(arr.shape[0]), raw] = 1.0
-        else:
-            features = arr
-            labels = arr
-        super().__init__(batch_size, features.shape[0])
-        self.data = DataSet(features, labels)
-
-    def input_columns(self) -> int:
-        return int(self.data.features.shape[1])
-
-    def total_outcomes(self) -> int:
-        return int(self.data.labels.shape[1])
-
-    def _fetch(self, start: int, end: int) -> DataSet:
-        return DataSet(self.data.features[start:end],
-                       self.data.labels[start:end])
+from deeplearning4j_tpu.datasets.records import (  # noqa: F401
+    CSVRecordReader,
+    RecordReaderDataSetIterator,
+)
 
 
 class CSVDataSetIterator(RecordReaderDataSetIterator):
     def __init__(self, path: str, batch_size: int, label_index: int = -1,
                  num_classes: Optional[int] = None, delimiter: str = ",",
                  skip_lines: int = 0):
+        if label_index is not None and not num_classes:
+            raise ValueError(
+                "label_index given without num_classes; pass num_classes "
+                "for classification or label_index=None for reconstruction")
         super().__init__(CSVRecordReader(path, delimiter, skip_lines),
-                         batch_size, label_index, num_classes)
+                         batch_size, label_index=label_index,
+                         num_possible_labels=num_classes)

@@ -116,3 +116,13 @@ class MnistDataSetIterator(DataSetIterator):
     def _fetch(self, start: int, end: int) -> DataSet:
         return DataSet(self.data.features[start:end],
                        self.data.labels[start:end])
+
+
+class RawMnistDataSetIterator(MnistDataSetIterator):
+    """MNIST without binarization (reference iterator/impl/
+    RawMnistDataSetIterator.java — the raw-pixel variant)."""
+
+    def __init__(self, batch_size: int, num_examples: int,
+                 data_dir: str = "data/mnist", train: bool = True):
+        super().__init__(batch_size, num_examples, data_dir=data_dir,
+                         train=train, binarize=False)

@@ -1,0 +1,21 @@
+"""Utility subsystem (reference core/util/, 27 files ~5.6k LoC — the
+used-by-something subset): Viterbi sequence smoothing, MathUtils,
+disk-spilling queue, pickle-free serialization, moving-window matrix
+extraction, image loading, archive extraction."""
+
+from deeplearning4j_tpu.utils.viterbi import Viterbi  # noqa: F401
+from deeplearning4j_tpu.utils.disk_based_queue import (  # noqa: F401
+    DiskBasedQueue,
+)
+from deeplearning4j_tpu.utils.serialization import (  # noqa: F401
+    from_bytes,
+    read_object,
+    save_object,
+    to_bytes,
+)
+from deeplearning4j_tpu.utils.moving_window_matrix import (  # noqa: F401
+    MovingWindowMatrix,
+)
+from deeplearning4j_tpu.utils.image_loader import ImageLoader  # noqa: F401
+from deeplearning4j_tpu.utils.archive import unzip_file_to  # noqa: F401
+from deeplearning4j_tpu.utils import math_utils  # noqa: F401

@@ -1,0 +1,96 @@
+"""Viterbi decoder for smoothing observed label sequences.
+
+Parity: reference core/util/Viterbi.java:31-192 — a fixed two-parameter
+markov chain over the label states: emission log-prob is log(pCorrect)
+when a state matches the observed label and log((1-pCorrect)/(states-1))
+otherwise; transition log-prob is log(metaStability) for staying in the
+same state and log((1-metaStability)/(states-1)) for switching. `decode`
+accepts either an outcome-index sequence or a binary (one-hot) label
+matrix and returns (best path log-prob, decoded state sequence).
+
+The reference's backpointer matrix was never filled (Viterbi.java:77-105
+computes `pointers` but only writes zeros) and its probability formulas
+dropped parentheses (`1 - pCorrect / states - 1`); both are alpha-era
+bugs, deliberately not reproduced — this is the intended algorithm as a
+single jitted lax.scan forward pass + reverse backtrace.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _viterbi_decode(observed: jnp.ndarray, states: int,
+                    log_p_correct: float, log_p_incorrect: float,
+                    log_stay: float, log_switch: float):
+    """Max-product forward pass with backpointers, then backtrace."""
+    trans = jnp.full((states, states), log_switch).at[
+        jnp.arange(states), jnp.arange(states)].set(log_stay)
+
+    def emission(obs):
+        return jnp.where(jnp.arange(states) == obs,
+                         log_p_correct, log_p_incorrect)
+
+    v0 = emission(observed[0]) - math.log(states)
+
+    def step(v_prev, obs):
+        # scores[j, k]: arriving in k from j
+        scores = v_prev[:, None] + trans
+        best_prev = jnp.argmax(scores, axis=0)
+        v = jnp.max(scores, axis=0) + emission(obs)
+        return v, best_prev
+
+    v_final, pointers = jax.lax.scan(step, v0, observed[1:])
+    last = jnp.argmax(v_final)
+    best_logp = v_final[last]
+
+    def back(state, ptr_row):
+        return ptr_row[state], ptr_row[state]
+
+    _, rest = jax.lax.scan(back, last, pointers, reverse=True)
+    path = jnp.concatenate([rest, jnp.array([last])])
+    return best_logp, path
+
+
+class Viterbi:
+    """See module docstring; constructor mirrors Viterbi(possibleLabels)."""
+
+    def __init__(self, possible_labels, meta_stability: float = 0.9,
+                 p_correct: float = 0.99):
+        self.possible_labels = np.asarray(possible_labels).ravel()
+        self.states = int(self.possible_labels.shape[0])
+        if self.states < 2:
+            raise ValueError("Viterbi needs at least 2 states")
+        self.meta_stability = meta_stability
+        self.p_correct = p_correct
+
+    def decode(self, labels,
+               binary_label_matrix: bool = True) -> Tuple[float, np.ndarray]:
+        """Returns (log-prob of the best path, decoded outcome sequence).
+
+        `labels`: (frames, states) one-hot matrix when binary_label_matrix
+        (reference toOutcomesFromBinaryLabelMatrix via argmax) else a
+        1-D outcome-index sequence.
+        """
+        labels = np.asarray(labels)
+        if labels.ndim == 2 and binary_label_matrix:
+            observed = labels.argmax(axis=-1)
+        else:
+            observed = labels.ravel().astype(np.int64)
+        if observed.shape[0] == 0:
+            raise ValueError("Cannot decode an empty sequence")
+        n = self.states
+        logp, path = _viterbi_decode(
+            jnp.asarray(observed), n,
+            math.log(self.p_correct),
+            math.log((1.0 - self.p_correct) / (n - 1)),
+            math.log(self.meta_stability),
+            math.log((1.0 - self.meta_stability) / (n - 1)))
+        return float(logp), np.asarray(path)

@@ -1,0 +1,192 @@
+"""Util subsystem tests (reference core/util/*Test.java tier)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.utils import (
+    DiskBasedQueue,
+    ImageLoader,
+    MovingWindowMatrix,
+    Viterbi,
+    math_utils,
+    read_object,
+    save_object,
+    unzip_file_to,
+)
+
+
+class TestViterbi:
+    def test_smooths_isolated_flips(self):
+        # a long run of state 0 with one observation error -> decoded
+        # sequence removes the flip (metaStability favors staying; with
+        # p_correct=0.9 one mismatch is cheaper than two transitions)
+        observed = np.array([0, 0, 0, 1, 0, 0, 0])
+        v = Viterbi(np.array([0, 1]), p_correct=0.9)
+        logp, path = v.decode(observed, binary_label_matrix=False)
+        np.testing.assert_array_equal(path, np.zeros(7))
+        assert logp < 0
+
+    def test_respects_persistent_switch(self):
+        observed = np.array([0, 0, 0, 1, 1, 1, 1])
+        v = Viterbi(np.array([0, 1]))
+        _, path = v.decode(observed, binary_label_matrix=False)
+        np.testing.assert_array_equal(path, observed)
+
+    def test_binary_label_matrix_input(self):
+        labels = np.eye(3)[[2, 2, 2, 2]]
+        v = Viterbi(np.array([0, 1, 2]))
+        _, path = v.decode(labels)
+        np.testing.assert_array_equal(path, [2, 2, 2, 2])
+
+    def test_empty_rejected(self):
+        v = Viterbi(np.array([0, 1]))
+        with pytest.raises(ValueError):
+            v.decode(np.array([]), binary_label_matrix=False)
+
+
+class TestMathUtils:
+    def test_normalize_discretize_clamp(self):
+        assert math_utils.normalize(5, 0, 10) == 0.5
+        assert math_utils.clamp(12, 0, 10) == 10
+        assert math_utils.discretize(0.99, 0, 1, 10) == 9
+        assert math_utils.discretize(0.0, 0, 1, 10) == 0
+
+    def test_next_pow_2(self):
+        assert math_utils.next_pow_2(1) == 1
+        assert math_utils.next_pow_2(5) == 8
+        assert math_utils.next_pow_2(64) == 64
+
+    def test_entropy_information(self):
+        assert math_utils.entropy([1.0]) == pytest.approx(0.0)
+        assert math_utils.information([0.5, 0.5]) == pytest.approx(-1.0)
+
+    def test_tfidf(self):
+        t = math_utils.tf(9)  # log10(10) = 1
+        i = math_utils.idf(100, 9)  # log10(10) = 1
+        assert math_utils.tfidf(t, i) == pytest.approx(1.0)
+
+    def test_ols_weights(self):
+        x = [1.0, 2.0, 3.0, 4.0]
+        y = [3.0, 5.0, 7.0, 9.0]  # y = 2x + 1
+        assert math_utils.w_1(x, y, 4) == pytest.approx(2.0)
+        assert math_utils.w_0(x, y, 4) == pytest.approx(1.0)
+        assert math_utils.squared_loss(x, y, 1.0, 2.0) == pytest.approx(0.0)
+
+    def test_rmse_and_determination(self):
+        assert math_utils.root_means_squared_error(
+            [1, 2, 3], [1, 2, 3]) == 0.0
+        assert math_utils.determination_coefficient(
+            [1, 2, 3], [2, 4, 6], 3) == pytest.approx(1.0)
+
+    def test_logs2probs(self):
+        p = math_utils.logs2probs([0.0, 0.0])
+        np.testing.assert_allclose(p, [0.5, 0.5])
+
+    def test_string_similarity(self):
+        assert math_utils.string_similarity("night", "night") == 1.0
+        assert math_utils.string_similarity("night", "nacht") == \
+            pytest.approx(0.25)
+        assert math_utils.string_similarity("ab", "cd") == 0.0
+
+    def test_combinatorics(self):
+        assert math_utils.combination(5, 2) == 10
+        assert math_utils.permutation(5, 2) == 20
+        assert math_utils.prob_to_log_odds(0.5) == 0.0
+
+
+class TestDiskBasedQueue:
+    def test_fifo_spill_round_trip(self, tmp_path):
+        with DiskBasedQueue(str(tmp_path / "q")) as q:
+            q.add({"step": 1, "params": np.arange(4.0)})
+            q.add({"step": 2, "params": np.ones((2, 2))})
+            assert q.size() == 2
+            # payloads live on disk, not RAM
+            import os
+            assert len(os.listdir(q.dir)) == 2
+            first = q.poll()
+            assert first["step"] == 1
+            np.testing.assert_array_equal(first["params"], np.arange(4.0))
+            assert q.poll()["step"] == 2
+            assert q.poll() is None
+            assert q.is_empty()
+
+    def test_peek_does_not_remove(self, tmp_path):
+        with DiskBasedQueue(str(tmp_path / "q")) as q:
+            q.add("hello")
+            assert q.peek() == "hello"
+            assert q.size() == 1
+
+    def test_drain_iterator(self, tmp_path):
+        with DiskBasedQueue(str(tmp_path / "q")) as q:
+            q.add_all([1, 2, 3])
+            assert list(q) == [1, 2, 3]
+            assert q.is_empty()
+
+    def test_remove_on_empty_raises(self, tmp_path):
+        with DiskBasedQueue(str(tmp_path / "q")) as q:
+            with pytest.raises(IndexError):
+                q.remove()
+
+
+class TestSerialization:
+    def test_round_trip(self, tmp_path):
+        obj = {"a": np.eye(3), "b": [1, 2, {"c": "x"}], "d": None}
+        path = save_object(obj, str(tmp_path / "obj.bin"))
+        loaded = read_object(path)
+        np.testing.assert_array_equal(loaded["a"], np.eye(3))
+        assert loaded["b"] == [1, 2, {"c": "x"}]
+        assert loaded["d"] is None
+
+
+class TestMovingWindowMatrix:
+    def test_all_windows(self):
+        m = np.arange(16).reshape(4, 4)
+        wins = MovingWindowMatrix(m, 2, 2).windows()
+        assert len(wins) == 9  # 3x3 offsets
+        np.testing.assert_array_equal(wins[0], [[0, 1], [4, 5]])
+        np.testing.assert_array_equal(wins[-1], [[10, 11], [14, 15]])
+
+    def test_flattened_and_rotate(self):
+        m = np.arange(4).reshape(2, 2)
+        plain = MovingWindowMatrix(m, 2, 2).windows(flattened=True)
+        assert len(plain) == 1 and plain[0].shape == (4,)
+        rot = MovingWindowMatrix(m, 2, 2, add_rotate=True).windows()
+        assert len(rot) == 4  # original + 3 rotations
+        np.testing.assert_array_equal(rot[1], np.rot90(m))
+
+    def test_window_too_big_rejected(self):
+        with pytest.raises(ValueError):
+            MovingWindowMatrix(np.eye(2), 3, 3)
+
+
+class TestImageLoaderAndArchive:
+    def test_image_round_trip(self, tmp_path):
+        from PIL import Image
+
+        arr = (np.arange(100).reshape(10, 10) * 2).astype(np.uint8)
+        p = str(tmp_path / "img.png")
+        Image.fromarray(arr, mode="L").save(p)
+        loader = ImageLoader(height=5, width=5)
+        mat = loader.as_matrix(p)
+        assert mat.shape == (5, 5) and mat.dtype == np.float32
+        assert loader.as_row_vector(p).shape == (25,)
+        assert loader.shape == (5, 5)
+
+    def test_unzip(self, tmp_path):
+        import zipfile
+
+        z = str(tmp_path / "a.zip")
+        with zipfile.ZipFile(z, "w") as f:
+            f.writestr("sub/data.txt", "hello")
+        dest = str(tmp_path / "out")
+        unzip_file_to(z, dest)
+        assert (tmp_path / "out" / "sub" / "data.txt").read_text() == "hello"
+
+    def test_zip_traversal_rejected(self, tmp_path):
+        import zipfile
+
+        z = str(tmp_path / "evil.zip")
+        with zipfile.ZipFile(z, "w") as f:
+            f.writestr("../escape.txt", "bad")
+        with pytest.raises(ValueError):
+            unzip_file_to(z, str(tmp_path / "out2"))
