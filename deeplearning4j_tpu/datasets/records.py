@@ -58,6 +58,19 @@ class RecordReader:
         rec, self._pending = self._pending, None
         return rec
 
+    def peek(self) -> Optional[Record]:
+        """First pending record without consuming it (None if exhausted)."""
+        return self._pending if self.has_next() else None
+
+    def count(self) -> Optional[int]:
+        """Total record count if cheaply known up front, else None.
+
+        Streaming readers return None; composing iterators
+        (MultipleEpochs/Reconstruction) need this to size themselves, so
+        in-memory readers override it.
+        """
+        return None
+
     def records(self) -> Iterable[Record]:
         self.reset()
         while self.has_next():
@@ -73,6 +86,9 @@ class ListRecordReader(RecordReader):
 
     def _iter(self):
         return iter(self._records)
+
+    def count(self) -> int:
+        return len(self._records)
 
 
 class CSVRecordReader(RecordReader):
@@ -169,21 +185,37 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.pre_processor: Optional[DataSetPreProcessor] = None
         self.reader.reset()
         self._seen = 0
+        self._record_width: Optional[int] = None
 
-    # dynamic stream: totals unknown until exhausted
+    # Totals: use the reader's up-front count when it has one (in-memory
+    # readers); for true streams fall back to the count seen so far, which
+    # only becomes the total after exhaustion — composing iterators that
+    # size themselves at construction should load_all() streams first.
     def total_examples(self) -> int:
-        return self._seen
+        n = self.reader.count()
+        return self._seen if n is None else n
 
     def num_examples(self) -> int:
-        return self._seen
+        return self.total_examples()
 
     def input_columns(self) -> int:
-        raise NotImplementedError("unknown for a streaming record reader")
+        """Feature width, learned by peeking the first record (the
+        reference CSVDataSetIterator knows its column count up front);
+        cached so it stays known after the stream is drained."""
+        if self._record_width is None:
+            rec = self.reader.peek()
+            if rec is None:
+                raise ValueError(
+                    "cannot determine input_columns: stream empty")
+            self._record_width = len(rec)
+        return self._record_width - (0 if self.label_index is None else 1)
 
     def total_outcomes(self) -> int:
         if self.num_possible_labels:
             return self.num_possible_labels
-        raise NotImplementedError("unknown for a streaming record reader")
+        if self.label_index is None:  # reconstruction: labels = features
+            return self.input_columns()
+        return 1  # regression: single float column
 
     def reset(self) -> None:
         self.reader.reset()
@@ -212,6 +244,8 @@ class RecordReaderDataSetIterator(DataSetIterator):
         feats, labels = [], []
         while len(feats) < n and self.reader.has_next():
             rec = self.reader.next_record()
+            if self._record_width is None:
+                self._record_width = len(rec)
             if self.label_index is None:
                 feats.append([float(v) for v in rec])
                 continue
@@ -240,7 +274,10 @@ class RecordReaderDataSetIterator(DataSetIterator):
         return self.pre_processor(ds) if self.pre_processor else ds
 
     def load_all(self) -> DataSet:
-        """Drain the stream into one DataSet."""
+        """Drain the stream into one DataSet (empty-shaped if no records)."""
         self.reset()
         batches = [ds for ds in self]
+        if not batches:
+            return DataSet(np.zeros((0, 0), np.float32),
+                           np.zeros((0, 0), np.float32))
         return DataSet.merge(batches)

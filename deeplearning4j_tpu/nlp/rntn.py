@@ -47,6 +47,14 @@ def _append_one(v):
     return jnp.concatenate([v, jnp.ones(v.shape[:-1] + (1,), v.dtype)], -1)
 
 
+def _transform_offset(d: int):
+    """[I/2, I/2, 0] near-averaging init offset for the binary transform
+    (reference randomTransformMatrix): the initial composition of children
+    [l; r; 1] is their average."""
+    return jnp.concatenate(
+        [jnp.eye(d), jnp.eye(d), jnp.zeros((d, 1))], axis=1) / 2.0
+
+
 class RNTN:
     """See module docstring. Numbers default to the reference's
     (RNTN.java:70-100): 25 hidden units, 3 output classes, tanh, tensors
@@ -155,7 +163,7 @@ class RNTN:
         p = self._params
         d = self.num_hidden
 
-        def grow(name, n_new, init_scale):
+        def grow(name, n_new, init_scale, offset=None):
             arr = p[name]
             n_old = arr.shape[0]
             if n_new <= n_old:
@@ -163,15 +171,31 @@ class RNTN:
             self.key, sub = jax.random.split(self.key)
             extra = jax.random.normal(
                 sub, (n_new - n_old,) + arr.shape[1:]) * init_scale
+            if offset is not None:
+                extra = extra + offset[None]
             p[name] = jnp.concatenate([arr, extra], axis=0)
             if self._adagrad_hist is not None:
                 self._adagrad_hist[name] = jnp.concatenate(
                     [self._adagrad_hist[name], jnp.zeros_like(extra)], axis=0)
 
+        n_emb_old = p["E"].shape[0]
         grow("E", len(self.word_index), self.scaling_for_init / d)
+        if self._feature_vectors_init and p["E"].shape[0] > n_emb_old:
+            # words first seen on a later fit() still get their pretrained
+            # vectors when the lookup table has them, like _init_params
+            emb = np.array(p["E"])  # np.asarray of a jax.Array is read-only
+            for word, idx in self.word_index.items():
+                if idx >= n_emb_old:
+                    vec = self._feature_vectors_init.get(word)
+                    if vec is not None:
+                        emb[idx] = np.asarray(vec, np.float32)[:d]
+            p["E"] = jnp.asarray(emb)
         n_cat = len(self.cat_index) if self.cat_index else 1
         n_ccat = len(self.ccat_index) if self.ccat_index else 1
-        grow("W", n_cat, self.scaling_for_init / (2 * d))
+        # categories first seen on a later fit() get the same [I/2, I/2, 0]
+        # near-averaging offset as _init_params (randomTransformMatrix)
+        grow("W", n_cat, self.scaling_for_init / (2 * d),
+             offset=_transform_offset(d))
         grow("Wu", n_ccat, self.scaling_for_init / d)
         if "T" in p:
             grow("T", n_cat, self.scaling_for_init / (4 * d * d))
@@ -193,9 +217,7 @@ class RNTN:
         # the transform's square blocks so the initial composition is
         # near-averaging (RNTN randomTransformMatrix)
         w = jax.random.normal(keys[1], (n_cat, d, 2 * d + 1)) * scale / (2 * d)
-        eye = jnp.concatenate(
-            [jnp.eye(d), jnp.eye(d), jnp.zeros((d, 1))], axis=1) / 2.0
-        params = {"W": w + eye[None],
+        params = {"W": w + _transform_offset(d)[None],
                   "Wu": jax.random.normal(keys[2], (n_ccat, c, d + 1))
                   * scale / d}
         if self.use_tensors:

@@ -126,8 +126,11 @@ def read_csv(path: str, delimiter: str = ",") -> np.ndarray:
     """Numeric CSV -> float32 matrix (native; numpy fallback)."""
     lib = _load()
     if lib is None:
+        # comments=None: the native parser rejects '#' lines as unparsable,
+        # so the fallback must too — behavior must not depend on whether
+        # the .so loaded.
         return np.loadtxt(path, delimiter=delimiter,
-                          dtype=np.float32, ndmin=2)
+                          dtype=np.float32, ndmin=2, comments=None)
     data = ctypes.POINTER(ctypes.c_float)()
     rows = ctypes.c_int64()
     cols = ctypes.c_int64()

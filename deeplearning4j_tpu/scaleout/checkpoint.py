@@ -40,8 +40,14 @@ def _encode_tree(obj, arrays: Dict[str, np.ndarray]):
     if obj is None or isinstance(obj, (bool, int, float, str)):
         return obj
     if isinstance(obj, (np.ndarray, np.generic, jax.Array)):
+        arr = np.asarray(obj)
+        if arr.dtype.hasobject:
+            # np.savez would silently pickle it, and load_payload
+            # (allow_pickle=False) could then never read it back — fail at
+            # save time, not restore time.
+            raise TypeError("Cannot checkpoint object-dtype array")
         key = f"a{len(arrays)}"
-        arrays[key] = np.asarray(obj)
+        arrays[key] = arr
         return {"__arr__": key}
     if hasattr(obj, "_fields"):  # NamedTuple
         name = type(obj).__name__

@@ -31,7 +31,11 @@ def unzip_file_to(file: str, dest: str) -> None:
         with tarfile.open(file, mode) as t:
             for member in t.getmembers():
                 _check_within(dest, os.path.join(dest, member.name))
-            t.extractall(dest)
+            # filter="data" additionally rejects symlink escapes (a symlink
+            # member pointing outside dest + a member written through it
+            # would pass the name check alone), absolute names and device
+            # files.
+            t.extractall(dest, filter="data")
     elif file.endswith(".gz"):
         out = os.path.join(dest, os.path.basename(file)[:-3])
         with gzip.open(file, "rb") as src, open(out, "wb") as dst:

@@ -186,6 +186,18 @@ class TestRNTN:
         enc = model.encode([parse_tree("(0 (1 cc) (0 dd))")])
         assert enc.word.max() == model.params()["E"].shape[0] - 1
 
+    def test_refit_new_words_use_pretrained_vectors(self):
+        fv = {"cc": np.full(6, 2.0, np.float32)}
+        model = RNTN(num_hidden=6, num_outs=2, lr=0.1, seed=0,
+                     feature_vectors=fv)
+        model.fit([parse_tree("(1 (1 aa) (0 bb))")], epochs=1)
+        model.fit([parse_tree("(0 (1 cc) (0 dd))")], epochs=1)
+        e = np.asarray(model.params()["E"])
+        # cc first appeared on the second fit() but still gets its
+        # pretrained vector (random init would be ~N(0, scale/d)), like
+        # words present at the first fit(); one epoch moves it slightly
+        assert np.allclose(e[model.word_index["cc"]], 2.0, atol=0.3)
+
     def test_batched_output_matches_predict(self):
         trees = sentiment_trees()
         model = RNTN(num_hidden=6, num_outs=2, lr=0.1, seed=0)

@@ -247,6 +247,14 @@ class TestCheckpoint:
         net2.fit(x, y, epochs=1)
         assert net2.score(x, y) <= s_before + 1e-3
 
+    def test_object_dtype_rejected_at_save_time(self):
+        from deeplearning4j_tpu.scaleout.checkpoint import dump_payload
+
+        ragged = np.empty(2, dtype=object)
+        ragged[0], ragged[1] = np.zeros(2), np.zeros(3)
+        with pytest.raises(TypeError):
+            dump_payload({"bad": ragged})
+
     def test_timestamp_rename_of_prior(self, tmp_path):
         path = str(tmp_path / "nn-model.ckpt")
         net = MultiLayerNetwork.from_config_json(iris_conf_json())

@@ -190,3 +190,26 @@ class TestImageLoaderAndArchive:
             f.writestr("../escape.txt", "bad")
         with pytest.raises(ValueError):
             unzip_file_to(z, str(tmp_path / "out2"))
+
+    def test_tar_symlink_escape_rejected(self, tmp_path):
+        import io
+        import tarfile
+
+        # symlink member pointing outside dest + a file written through it:
+        # member names alone pass the prefix check, filter="data" must
+        # reject the link
+        t = str(tmp_path / "evil.tar")
+        outside = tmp_path / "outside"
+        outside.mkdir()
+        with tarfile.open(t, "w") as f:
+            link = tarfile.TarInfo("link")
+            link.type = tarfile.SYMTYPE
+            link.linkname = str(outside)
+            f.addfile(link)
+            payload = tarfile.TarInfo("link/evil.txt")
+            data = b"bad"
+            payload.size = len(data)
+            f.addfile(payload, io.BytesIO(data))
+        with pytest.raises(tarfile.FilterError):
+            unzip_file_to(t, str(tmp_path / "out3"))
+        assert not (outside / "evil.txt").exists()
