@@ -76,11 +76,14 @@ class ConvolutionDownSampleLayer(BaseLayer):
             raise ValueError(
                 f"Filter {fh}x{fw} larger than input {x.shape[1]}x{x.shape[2]}")
         cd = jnp.dtype(c.compute_dtype)
+        # No preferred_element_type: an f32 output from bf16 primals makes
+        # the autodiff transpose feed an f32 cotangent into a bf16 conv
+        # (dtype error); casting after keeps forward AND backward convs
+        # uniformly in compute_dtype (TPU still accumulates bf16 in f32)
         conv = lax.conv_general_dilated(
             x.astype(cd), params["W"].astype(cd),
             window_strides=(1, 1), padding="VALID",
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32,
         ).astype(jnp.dtype(c.dtype))
         ph, pw = self._pool_hw()
         pooled = lax.reduce_window(

@@ -12,7 +12,7 @@ Word2Vec step unchanged.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import numpy as np
 
@@ -45,26 +45,34 @@ class ParagraphVectors(Word2Vec):
     def _label_token(label: str) -> str:
         return f"__label__{label}"
 
-    def _mine_pairs(self, rng: np.random.RandomState
-                    ) -> Tuple[np.ndarray, np.ndarray]:
-        centers, contexts = super()._mine_pairs(rng)
+    def _iter_pair_chunks(self, rng: np.random.RandomState,
+                          chunk_tokens: int = 1 << 18):
+        yield from super()._iter_pair_chunks(rng, chunk_tokens)
         # PV-DBOW: each doc's label predicts every word of the doc
-        # (reference trains the label word in every window, :61)
-        lab_centers: List[int] = []
-        lab_contexts: List[int] = []
+        # (reference trains the label word in every window, :61).
+        # Chunked like the base stream so a corpus-scale labeled set never
+        # materializes all label pairs at once; label pairs carry no new
+        # corpus words (words_seen += 0: base chunks counted them).
+        lab_centers: List[np.ndarray] = []
+        lab_contexts: List[np.ndarray] = []
+        buffered = 0
         for label, sentence in self.labeled:
             li = self.vocab.index_of(self._label_token(label))
             if li < 0:
                 continue
-            for t in self.tokenizer_factory.tokenize(sentence):
-                wi = self.vocab.index_of(t)
-                if wi >= 0:
-                    lab_centers.append(wi)   # predict word via its codes
-                    lab_contexts.append(li)  # from the label's vector
-        return (np.concatenate([centers,
-                                np.asarray(lab_centers, np.int32)]),
-                np.concatenate([contexts,
-                                np.asarray(lab_contexts, np.int32)]))
+            words = self._tokens_to_indices(sentence)
+            if words.size:
+                lab_centers.append(words)   # predict word via its codes
+                lab_contexts.append(        # from the label's vector
+                    np.full(words.size, li, np.int32))
+                buffered += words.size
+            if buffered >= chunk_tokens:
+                yield (np.concatenate(lab_centers),
+                       np.concatenate(lab_contexts), 0)
+                lab_centers, lab_contexts, buffered = [], [], 0
+        if lab_centers:
+            yield (np.concatenate(lab_centers),
+                   np.concatenate(lab_contexts), 0)
 
     # ---------------------------------------------------------------- query
     def label_vector(self, label: str) -> Optional[np.ndarray]:

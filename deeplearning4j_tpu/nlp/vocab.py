@@ -88,6 +88,32 @@ class VocabCache:
     def vocab_words(self) -> List[VocabWord]:
         return [self._words[w] for w in self._index]
 
+    # -------------------------------------------------------- serialization
+    def to_dict(self) -> Dict:
+        """JSON-able snapshot (index order, counts, Huffman codes/points) —
+        the distributed wire format for shipping a built vocab to worker
+        processes (reference Word2VecWork carries the vocab words)."""
+        return {
+            "words": [{"w": vw.word, "c": vw.count,
+                       "codes": list(vw.codes), "points": list(vw.points)}
+                      for vw in self.vocab_words()],
+            "total_word_count": self.total_word_count,
+            "num_docs": self.num_docs,
+        }
+
+    @classmethod
+    def from_dict(cls, data: Dict) -> "VocabCache":
+        cache = cls()
+        for i, rec in enumerate(data["words"]):
+            vw = VocabWord(word=rec["w"], count=rec["c"], index=i,
+                           codes=[int(c) for c in rec["codes"]],
+                           points=[int(p) for p in rec["points"]])
+            cache._words[vw.word] = vw
+            cache._index.append(vw.word)
+        cache.total_word_count = data.get("total_word_count", 0.0)
+        cache.num_docs = data.get("num_docs", 0)
+        return cache
+
     def truncate(self, min_word_frequency: float) -> None:
         """Drop words below the frequency floor and re-index by descending
         count (word2vec convention: index 0 = most frequent)."""

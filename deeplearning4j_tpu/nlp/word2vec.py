@@ -128,6 +128,8 @@ class Word2Vec(WordVectors):
         self.syn1 = None
         self.syn1neg = None
         self._code_len = 0
+        self.pairs_trained = 0
+        self._step_cache = None  # jitted step, keyed to the built vocab
         self._key = jax.random.PRNGKey(seed)
 
     # ----------------------------------------------------------- vocab/init
@@ -138,6 +140,7 @@ class Word2Vec(WordVectors):
         self._extend_vocab()  # hook: subclasses add pseudo-words (labels)
         build_huffman(self.vocab)
         self._code_len = max(1, max_code_length(self.vocab))
+        self._step_cache = None  # vocab-dependent shapes changed
 
     def _extend_vocab(self) -> None:
         pass
@@ -176,36 +179,91 @@ class Word2Vec(WordVectors):
             mask[vw.index, :ln] = 1.0
         return codes, points, mask
 
-    def _mine_pairs(self, rng: np.random.RandomState
-                    ) -> Tuple[np.ndarray, np.ndarray]:
-        """Host-side pair mining: skip-gram windows with the word2vec random
-        window shrink (reference skipGram :314 trains syn0[context] against
-        the CENTER word's codes) + optional frequent-word subsampling."""
-        centers, contexts = [], []
+    def _keep_probs(self) -> np.ndarray:
+        """Per-vocab-index subsampling keep probability (reference
+        trainSentence's frequent-word subsampling, vectorized as a table)."""
         total = max(1.0, self.vocab.total_word_count)
+        counts = np.array([vw.count for vw in self.vocab.vocab_words()],
+                          np.float64)
+        f = np.maximum(counts, 1.0) / total
+        keep = (np.sqrt(f / self.sample) + 1.0) * self.sample / f
+        return np.minimum(keep, 1.0)
+
+    def _tokens_to_indices(self, sentence: str) -> np.ndarray:
+        toks = self.tokenizer_factory.tokenize(sentence)
+        idx = np.fromiter((self.vocab.index_of(t) for t in toks),
+                          np.int32, count=len(toks))
+        return idx[idx >= 0]
+
+    @staticmethod
+    def _window_pairs(idx: np.ndarray, sid: np.ndarray, window: int,
+                      rng: np.random.RandomState
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """Vectorized skip-gram windowing over concatenated sentences.
+
+        `idx` holds vocab indices, `sid` the sentence id of each position.
+        For every offset 1..window, pairs (center@i, context@i±off) are
+        kept when both positions share a sentence and off <= b[i], where b
+        is the per-center random window shrink (reference skipGram :314's
+        `b = random % window` semantics) — no Python per-token loop.
+        """
+        n = idx.size
+        if n == 0:
+            return (np.empty(0, np.int32),) * 2
+        b = rng.randint(1, window + 1, size=n)
+        cs, xs = [], []
+        for off in range(1, window + 1):
+            if off >= n:
+                break
+            same = sid[off:] == sid[:-off]
+            m = same & (b[off:] >= off)      # context BEFORE center
+            cs.append(idx[off:][m])
+            xs.append(idx[:-off][m])
+            m = same & (b[:-off] >= off)     # context AFTER center
+            cs.append(idx[:-off][m])
+            xs.append(idx[off:][m])
+        return np.concatenate(cs), np.concatenate(xs)
+
+    def _iter_pair_chunks(self, rng: np.random.RandomState,
+                          chunk_tokens: int = 1 << 18
+                          ):
+        """Stream (centers, contexts, words_seen) chunks: sentences are
+        tokenized and buffered up to ~chunk_tokens indices, then windowed
+        in one vectorized shot. A text8-scale corpus (~17M tokens, ~1e8
+        pairs at window 5) never materializes more than one chunk of pairs
+        (~2.6M) in RAM. Overridable (ParagraphVectors appends label pairs).
+        """
+        keep = self._keep_probs() if self.sample > 0 else None
+        buf_idx: List[np.ndarray] = []
+        buf_sid: List[np.ndarray] = []
+        count = 0
+        sid = 0
+
+        words_in_buf = 0  # in-vocab tokens BEFORE subsampling: the alpha
+        # decay numerator must count the same mass as its denominator
+        # (sum of kept-vocab counts), which subsampling doesn't reduce
+
+        def flush():
+            idx = np.concatenate(buf_idx)
+            s = np.concatenate(buf_sid)
+            c, x = self._window_pairs(idx, s, self.window, rng)
+            return c, x, words_in_buf
+
         for sentence in self.sentence_iter:
-            toks = self.tokenizer_factory.tokenize(sentence)
-            idxs = [self.vocab.index_of(t) for t in toks]
-            idxs = [i for i in idxs if i >= 0]
-            if self.sample > 0:
-                kept = []
-                for i in idxs:
-                    f = self.vocab.word_frequency(self.vocab.word_at(i)) / total
-                    keep_p = (np.sqrt(f / self.sample) + 1) * self.sample / f
-                    if rng.rand() < keep_p:
-                        kept.append(i)
-                idxs = kept
-            for pos, center in enumerate(idxs):
-                b = rng.randint(1, self.window + 1)  # shrunk window
-                for off in range(-b, b + 1):
-                    if off == 0:
-                        continue
-                    j = pos + off
-                    if 0 <= j < len(idxs):
-                        centers.append(center)
-                        contexts.append(idxs[j])
-        return (np.asarray(centers, np.int32),
-                np.asarray(contexts, np.int32))
+            arr = self._tokens_to_indices(sentence)
+            words_in_buf += arr.size
+            if keep is not None and arr.size:
+                arr = arr[rng.rand(arr.size) < keep[arr]]
+            if arr.size:
+                buf_idx.append(arr)
+                buf_sid.append(np.full(arr.size, sid, np.int32))
+                count += arr.size
+                sid += 1
+            if count >= chunk_tokens:
+                yield flush()
+                buf_idx, buf_sid, count, words_in_buf = [], [], 0, 0
+        if count:
+            yield flush()
 
     def _build_step(self):
         codes, points, mask = self._codes_points()
@@ -214,22 +272,46 @@ class Word2Vec(WordVectors):
         negative = self.negative
         uni_logits = self._unigram_logits() if negative > 0 else None
 
+        def _bce(logits, labels):
+            return (jnp.maximum(logits, 0) - logits * labels
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
         def loss_fn(tables, centers, contexts, negs):
+            """Batched equivalent of the reference's sequential per-pair
+            axpy updates. Each ROW (of syn0 OR syn1/syn1neg) moves by the
+            MEAN gradient over the pairs touching it in this batch, at the
+            full per-pair alpha: a plain sum diverges whenever a hot row
+            (small vocab; the HS root node; frequent negative targets)
+            accumulates thousands of same-direction gradients that the
+            reference's re-read-each-step loop would have saturated, while
+            a plain mean scales the effective lr by 1/batch_pairs. The
+            two sides need different normalizations, so the loss is split
+            with stop_gradient: the first term only trains syn0, the
+            second only trains syn1/syn1neg."""
             syn0 = tables["syn0"]
             l1 = syn0[contexts]  # (B, D) — reference trains syn0[context]
+            l1_sg = jax.lax.stop_gradient(l1)
+            counts = jnp.zeros(syn0.shape[0],
+                               jnp.float32).at[contexts].add(1.0)
+            w = 1.0 / counts[contexts]  # (B,) syn0-side weights
             loss = 0.0
             if "syn1" in tables:
                 # hierarchical softmax over the center word's code path
                 p = points_t[centers]          # (B, L)
                 c = codes_t[centers]           # (B, L)
                 m = mask_t[centers]            # (B, L)
-                logits = jnp.einsum("bd,bld->bl", l1, tables["syn1"][p])
                 labels = 1.0 - c               # word2vec label convention
-                bce = jnp.maximum(logits, 0) - logits * labels + \
-                    jnp.log1p(jnp.exp(-jnp.abs(logits)))
-                # sum over the code path, mean over pairs: matches the
-                # reference's per-pair accumulation of one update per bit
-                loss = loss + jnp.mean(jnp.sum(bce * m, axis=1))
+                rows = tables["syn1"][p]       # (B, L, D)
+                pc = jnp.zeros(tables["syn1"].shape[0],
+                               jnp.float32).at[p].add(m)
+                u = m / jnp.maximum(pc[p], 1.0)  # (B, L) syn1-side weights
+                syn0_side = _bce(
+                    jnp.einsum("bd,bld->bl", l1,
+                               jax.lax.stop_gradient(rows)), labels)
+                syn1_side = _bce(
+                    jnp.einsum("bd,bld->bl", l1_sg, rows), labels)
+                loss = loss + jnp.sum(w[:, None] * syn0_side * m) \
+                    + jnp.sum(u * syn1_side * m)
             if "syn1neg" in tables:
                 tgt = jnp.concatenate([centers[:, None], negs], axis=1)
                 labels = jnp.concatenate(
@@ -240,10 +322,17 @@ class Word2Vec(WordVectors):
                 valid = jnp.concatenate(
                     [jnp.ones_like(centers[:, None], jnp.float32),
                      (negs != centers[:, None]).astype(jnp.float32)], axis=1)
-                logits = jnp.einsum("bd,bkd->bk", l1, tables["syn1neg"][tgt])
-                bce = jnp.maximum(logits, 0) - logits * labels + \
-                    jnp.log1p(jnp.exp(-jnp.abs(logits)))
-                loss = loss + jnp.mean(jnp.sum(bce * valid, axis=1))
+                rows = tables["syn1neg"][tgt]  # (B, K, D)
+                tc = jnp.zeros(tables["syn1neg"].shape[0],
+                               jnp.float32).at[tgt].add(valid)
+                u = valid / jnp.maximum(tc[tgt], 1.0)
+                syn0_side = _bce(
+                    jnp.einsum("bd,bkd->bk", l1,
+                               jax.lax.stop_gradient(rows)), labels)
+                syn1_side = _bce(
+                    jnp.einsum("bd,bkd->bk", l1_sg, rows), labels)
+                loss = loss + jnp.sum(w[:, None] * syn0_side * valid) \
+                    + jnp.sum(u * syn1_side * valid)
             return loss
 
         @jax.jit
@@ -263,7 +352,9 @@ class Word2Vec(WordVectors):
 
     def fit(self) -> "Word2Vec":
         """reference fit :101: build vocab, Huffman, reset weights, train
-        with lr decaying by pairs seen."""
+        with lr decaying by words seen (Word2Vec.java :191-296's
+        `alpha * (1 - wordsSeen/totalWords)`), streaming pair chunks so a
+        text8-scale corpus trains in bounded memory."""
         if self.sentence_iter is None:
             raise ValueError("Word2Vec needs sentences")
         if self.vocab.num_words() == 0:
@@ -271,42 +362,68 @@ class Word2Vec(WordVectors):
         if self.syn0 is None:
             self.reset_weights()
         rng = np.random.RandomState(self.seed)
-        centers, contexts = self._mine_pairs(rng)
-        if centers.size == 0:
-            raise ValueError("No training pairs (vocab/corpus too small)")
-        step = self._build_step()
+        if self._step_cache is None:
+            self._step_cache = self._build_step()
+        step = self._step_cache
 
         tables = {"syn0": self.syn0}
         if self.syn1 is not None:
             tables["syn1"] = self.syn1
         if self.syn1neg is not None:
             tables["syn1neg"] = self.syn1neg
-        n = centers.shape[0]
-        total_steps = max(1, self.iterations * ((n - 1) // self.batch_pairs
-                                                + 1))
-        step_i = 0
+
+        # denominator = kept-vocab token mass (total_word_count still
+        # includes mass truncate() dropped, which words_seen never counts —
+        # using it would stall the decay well above min_alpha)
+        kept_mass = sum(vw.count for vw in self.vocab.vocab_words())
+        total_words = max(1.0, float(kept_mass) * self.iterations)
+        words_seen = 0
+        self.pairs_trained = 0
         loss = None
+        B = self.batch_pairs
+        carry_c = np.empty(0, np.int32)
+        carry_x = np.empty(0, np.int32)
+
+        def train_batch(bc, bx, ts):
+            nonlocal tables
+            self._key, k = jax.random.split(self._key)
+            alpha = max(self.min_alpha,
+                        self.alpha * (1.0 - words_seen / total_words))
+            ts, ls = step(ts, jnp.asarray(bc), jnp.asarray(bx),
+                          jnp.float32(alpha), k)
+            return ts, ls
+
         for _ in range(self.iterations):
-            order = rng.permutation(n)
-            for lo in range(0, n, self.batch_pairs):
-                sel = order[lo:lo + self.batch_pairs]
-                # static batch shape: tile the tail so jit compiles once
-                if sel.size < self.batch_pairs:
-                    sel = np.concatenate(
-                        [sel, sel[np.arange(self.batch_pairs - sel.size)
-                                  % sel.size]])
-                alpha = max(self.min_alpha,
-                            self.alpha * (1.0 - step_i / total_steps))
-                self._key, k = jax.random.split(self._key)
-                tables, loss = step(tables, jnp.asarray(centers[sel]),
-                                    jnp.asarray(contexts[sel]),
-                                    jnp.float32(alpha), k)
-                step_i += 1
+            for centers, contexts, n_words in self._iter_pair_chunks(rng):
+                self.pairs_trained += centers.size
+                perm = rng.permutation(centers.size)
+                centers = np.concatenate([carry_c, centers[perm]])
+                contexts = np.concatenate([carry_x, contexts[perm]])
+                n_full = centers.size // B * B
+                for lo in range(0, n_full, B):
+                    tables, loss = train_batch(centers[lo:lo + B],
+                                               contexts[lo:lo + B], tables)
+                # remainder rides into the next chunk, keeping every jitted
+                # batch the same static shape
+                carry_c, carry_x = centers[n_full:], contexts[n_full:]
+                # decay lags the chunk (the reference decays by words
+                # ALREADY seen) so the first batch trains at full alpha and
+                # the last iteration is not spent at min_alpha
+                words_seen += n_words
+            if carry_c.size:  # iteration tail: tile up to the batch shape
+                pad = np.arange(B - carry_c.size) % carry_c.size
+                tables, loss = train_batch(
+                    np.concatenate([carry_c, carry_c[pad]]),
+                    np.concatenate([carry_x, carry_x[pad]]), tables)
+                carry_c = np.empty(0, np.int32)
+                carry_x = np.empty(0, np.int32)
+        if self.pairs_trained == 0:
+            raise ValueError("No training pairs (vocab/corpus too small)")
         self.syn0 = tables["syn0"]
         self.syn1 = tables.get("syn1")
         self.syn1neg = tables.get("syn1neg")
-        log.info("word2vec trained: %d pairs, final loss %.4f", n,
-                 float(loss))
+        log.info("word2vec trained: %d pairs, final loss %.4f",
+                 self.pairs_trained, float(loss))
         # refresh the WordVectors view
         WordVectors.__init__(self, self.vocab, np.asarray(self.syn0))
         return self

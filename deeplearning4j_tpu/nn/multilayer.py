@@ -46,6 +46,8 @@ class MultiLayerNetwork:
         self._unravel = None
         self._updater_state = None
         self._train_step = None
+        self._finetune_solver = None
+        self._batch_solver = None
         self._pending_params = params
         self._iteration_count = 0
         self.listeners: List = []
@@ -80,6 +82,8 @@ class MultiLayerNetwork:
         _, self._unravel = ravel_pytree(self._params)
         self._updater_state = None
         self._train_step = None
+        self._finetune_solver = None
+        self._batch_solver = None
         if self._pending_params is not None:
             self.set_parameters(self._pending_params)
             self._pending_params = None
@@ -165,6 +169,17 @@ class MultiLayerNetwork:
         for i, layer in enumerate(self.layers[:-1]):
             if not hasattr(layer, "pretrain_loss"):
                 continue
+            # One solver per layer: the batch is a traced argument of the
+            # jitted step, so every mini-batch of this layer's phase reuses
+            # ONE compiled program instead of recompiling per batch
+            _, unravel_i = ravel_pytree(self._params[str(i)])
+
+            def flat_loss(vec, key, batch, *, _l=layer, _u=unravel_i):
+                return _l.pretrain_loss(_u(vec), batch, key)
+
+            solver = Solver(layer.conf, flat_loss,
+                            listeners=self.listeners, model=self,
+                            rng_key=self.next_key())
             for x in self._iter_batches(data):
                 cur = x
                 for j in range(i):
@@ -172,15 +187,8 @@ class MultiLayerNetwork:
                     cur = self.layers[j].activate(self._params[str(j)], cur)
                     cur = self._layer_output(j, cur)
                 cur = self._layer_input(i, cur)
-                flat0, unravel_i = ravel_pytree(self._params[str(i)])
-
-                def flat_loss(vec, key):
-                    return layer.pretrain_loss(unravel_i(vec), cur, key)
-
-                solver = Solver(layer.conf, flat_loss,
-                                listeners=self.listeners, model=self,
-                                rng_key=self.next_key())
-                new_params, score = solver.optimize(self._params[str(i)])
+                new_params, score = solver.optimize(
+                    self._params[str(i)], cur, rng_key=self.next_key())
                 self._params[str(i)] = new_params
                 log.info("Pretrained layer %d (score=%s)", i, score)
 
@@ -231,15 +239,23 @@ class MultiLayerNetwork:
                 listener.iteration_done(self, self._iteration_count - 1,
                                         float(score))
         else:
-            flat0, unravel = ravel_pytree(self._params)
+            if self._batch_solver is None:
+                _, unravel = ravel_pytree(self._params)
 
-            def flat_loss(vec, key):
-                return self.loss_fn(unravel(vec), x, labels, rng=key,
-                                    training=True)
+                def flat_loss(vec, key, bx, by, *, _u=unravel):
+                    return self.loss_fn(_u(vec), bx, by, rng=key,
+                                        training=True)
 
-            solver = Solver(conf0, flat_loss, listeners=self.listeners,
-                            model=self, rng_key=self.next_key())
-            self._params, _ = solver.optimize(self._params)
+                # cached: line-search solvers (CG/LBFGS/HF) compile once;
+                # the batch is a traced argument (rng_key at construction
+                # marks the loss stochastic; per-batch keys come from the
+                # optimize override)
+                self._batch_solver = Solver(conf0, flat_loss,
+                                            listeners=self.listeners,
+                                            model=self,
+                                            rng_key=self.next_key())
+            self._params, _ = self._batch_solver.optimize(
+                self._params, x, labels, rng_key=self.next_key())
 
     def _get_train_step(self):
         if self._train_step is None:
@@ -273,14 +289,19 @@ class MultiLayerNetwork:
         hidden = self._frozen_features(x)
         out_idx = str(len(self.layers) - 1)
         out_layer = self.layers[-1]
-        flat0, unravel = ravel_pytree(self._params[out_idx])
+        if self._finetune_solver is None:
+            _, unravel = ravel_pytree(self._params[out_idx])
 
-        def flat_loss(vec):
-            return out_layer.loss(unravel(vec), hidden, jnp.asarray(labels))
+            def flat_loss(vec, hid, lab, *, _u=unravel):
+                return out_layer.loss(_u(vec), hid, lab)
 
-        solver = Solver(out_layer.conf, flat_loss, listeners=self.listeners,
-                        model=self)
-        new_params, _ = solver.optimize(self._params[out_idx])
+            # cached: repeated finetune batches (fit over a DataSetIterator)
+            # reuse one compiled step — hidden/labels are traced args
+            self._finetune_solver = Solver(out_layer.conf, flat_loss,
+                                           listeners=self.listeners,
+                                           model=self)
+        new_params, _ = self._finetune_solver.optimize(
+            self._params[out_idx], hidden, jnp.asarray(labels))
         self._params[out_idx] = new_params
 
     def _frozen_features(self, x, chunk_size: int = 4096) -> jnp.ndarray:

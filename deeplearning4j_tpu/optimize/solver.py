@@ -40,18 +40,25 @@ class Solver:
         self.terminations = terminations
         self.model = model
         self.optimizer_kwargs = optimizer_kwargs
+        self._optimizer: Optional[BaseOptimizer] = None
 
     def get_optimizer(self) -> BaseOptimizer:
-        algo = self.conf.optimization_algo.lower()
-        try:
-            cls = _ALGOS[algo]
-        except KeyError:
-            raise ValueError(
-                f"Unknown optimization algorithm {algo!r}; known: {sorted(_ALGOS)}"
-            ) from None
-        return cls(self.conf, self.loss, listeners=self.listeners,
-                   terminations=self.terminations, model=self.model,
-                   **self.optimizer_kwargs)
+        # one optimizer instance per Solver: its jitted step compiles once
+        # and is reused across optimize() calls (mini-batches)
+        if self._optimizer is None:
+            algo = self.conf.optimization_algo.lower()
+            try:
+                cls = _ALGOS[algo]
+            except KeyError:
+                raise ValueError(
+                    f"Unknown optimization algorithm {algo!r}; "
+                    f"known: {sorted(_ALGOS)}"
+                ) from None
+            self._optimizer = cls(
+                self.conf, self.loss, listeners=self.listeners,
+                terminations=self.terminations, model=self.model,
+                **self.optimizer_kwargs)
+        return self._optimizer
 
-    def optimize(self, params):
-        return self.get_optimizer().optimize(params)
+    def optimize(self, params, *data, rng_key=None):
+        return self.get_optimizer().optimize(params, *data, rng_key=rng_key)

@@ -62,35 +62,45 @@ class BaseOptimizer:
         )
         self.model = model
         self.rng_key = rng_key
+        self._step = None  # jitted step, compiled once per optimizer
         # Stochastic losses (CD Gibbs chains, denoising corruption, dropout)
-        # take (x, key) and get a FRESH key each iteration (fold_in of the
-        # iteration index); deterministic losses take (x,) and the key arg
-        # is ignored. The key is a traced argument so varying it never
-        # retriggers compilation.
+        # take (x, key, *data) and get a FRESH key each iteration (fold_in
+        # of the iteration index); deterministic losses take (x, *data) and
+        # the key arg is ignored. The key AND the data batch are traced
+        # arguments, so varying them never retriggers compilation — one
+        # optimizer instance serves every mini-batch of a phase
+        # (reference BaseOptimizer is likewise reused by its Solver).
         if rng_key is not None:
             self.loss = loss
         else:
-            self.loss = lambda x, key: loss(x)
+            self.loss = lambda x, key, *data: loss(x, *data)
 
-    # subclasses: (x, state, key) -> (x, state, score, grad_norm)
+    # subclasses: (x, state, key, *data) -> (x, state, score, grad_norm)
     def make_step(self):
         raise NotImplementedError
 
     def init_state(self, x):
         return ()
 
-    def optimize(self, params):
-        """Run the loop; params is a pytree; returns (params, final_score)."""
+    def optimize(self, params, *data, rng_key=None):
+        """Run the loop; params is a pytree; returns (params, final_score).
+        `data` arrays are forwarded to the loss as traced arguments;
+        `rng_key` overrides the construction-time key (fresh stochasticity
+        per mini-batch without recompiling)."""
         x, unravel = ravel_pytree(params)
-        step = self.make_step()
+        if self._step is None:
+            self._step = self.make_step()
+        step = self._step
         state = self.init_state(x)
         old_score = float("inf")
         score = None
-        base_key = (self.rng_key if self.rng_key is not None
+        if rng_key is None:
+            rng_key = self.rng_key
+        base_key = (rng_key if rng_key is not None
                     else jax.random.PRNGKey(0))
         for i in range(self.conf.num_iterations):
             x, state, score_arr, gnorm_arr = step(
-                x, state, jax.random.fold_in(base_key, i))
+                x, state, jax.random.fold_in(base_key, i), *data)
             score, gnorm = float(score_arr), float(gnorm_arr)
             for listener in self.listeners:
                 listener.iteration_done(self.model, i, score)
@@ -114,8 +124,8 @@ class IterationGradientDescent(BaseOptimizer):
         sign = 1.0 if self.conf.minimize else -1.0
 
         @jax.jit
-        def step(x, state, key):
-            score, g = jax.value_and_grad(self.loss)(x, key)
+        def step(x, state, key, *data):
+            score, g = jax.value_and_grad(self.loss)(x, key, *data)
             updates, state = updater.update(g, state, x)
             return x - sign * updates, state, score, jnp.linalg.norm(g)
 
@@ -131,14 +141,15 @@ class GradientAscent(BaseOptimizer):
         max_iters = self.conf.num_line_search_iterations
 
         @jax.jit
-        def step(x, state, key):
-            score, g = jax.value_and_grad(self.loss)(x, key)
+        def step(x, state, key, *data):
+            score, g = jax.value_and_grad(self.loss)(x, key, *data)
             gnorm = jnp.linalg.norm(g)
             d = -g / (gnorm + 1e-12)
-            res = backtrack_line_search(lambda xx: self.loss(xx, key),
-                                        x, score, g, d,
-                                        initial_step=self.conf.lr,
-                                        max_iterations=max_iters)
+            res = backtrack_line_search(
+                lambda xx: self.loss(xx, key, *data),
+                x, score, g, d,
+                initial_step=self.conf.lr,
+                max_iterations=max_iters)
             return x + res.step * d, state, res.score, gnorm
 
         return step
@@ -154,9 +165,9 @@ class ConjugateGradient(BaseOptimizer):
         max_iters = self.conf.num_line_search_iterations
 
         @jax.jit
-        def step(x, state, key):
+        def step(x, state, key, *data):
             g_prev, d_prev, first = state
-            score, g = jax.value_and_grad(self.loss)(x, key)
+            score, g = jax.value_and_grad(self.loss)(x, key, *data)
             gnorm = jnp.linalg.norm(g)
             denom = jnp.vdot(g_prev, g_prev)
             beta = jnp.where(
@@ -168,7 +179,7 @@ class ConjugateGradient(BaseOptimizer):
             # Restart with steepest descent when d is not a descent direction
             descent = jnp.vdot(g, d) < 0
             d = jnp.where(descent, d, -g)
-            res = backtrack_line_search(lambda xx: self.loss(xx, key),
+            res = backtrack_line_search(lambda xx: self.loss(xx, key, *data),
                                         x, score, g,
                                         d / (jnp.linalg.norm(d) + 1e-12),
                                         initial_step=1.0,
@@ -208,9 +219,9 @@ class LBFGS(BaseOptimizer):
         max_ls = self.conf.num_line_search_iterations
 
         @jax.jit
-        def step(x, state, key):
+        def step(x, state, key, *data):
             S, Y, rho, count, x_prev, g_prev = state
-            score, g = jax.value_and_grad(self.loss)(x, key)
+            score, g = jax.value_and_grad(self.loss)(x, key, *data)
             gnorm = jnp.linalg.norm(g)
 
             # Update history with (s, y) from the last accepted step
@@ -252,7 +263,7 @@ class LBFGS(BaseOptimizer):
             d = -r
             descent = jnp.vdot(g, d) < 0
             d = jnp.where(descent, d, -g)
-            res = backtrack_line_search(lambda xx: self.loss(xx, key),
+            res = backtrack_line_search(lambda xx: self.loss(xx, key, *data),
                                         x, score, g, d,
                                         initial_step=1.0,
                                         max_iterations=max_ls)
@@ -291,18 +302,19 @@ class StochasticHessianFree(BaseOptimizer):
         cg_iters = self.cg_iterations
         user_matvec = self._user_matvec
 
-        def hvp(x, v, key):
+        def hvp(x, v, key, *data):
             if user_matvec is not None:
                 return user_matvec(x, v)
-            return jax.jvp(jax.grad(lambda xx: loss(xx, key)), (x,), (v,))[1]
+            return jax.jvp(jax.grad(lambda xx: loss(xx, key, *data)),
+                           (x,), (v,))[1]
 
         @jax.jit
-        def step(x, lam, key):
-            score, g = jax.value_and_grad(loss)(x, key)
+        def step(x, lam, key, *data):
+            score, g = jax.value_and_grad(loss)(x, key, *data)
             gnorm = jnp.linalg.norm(g)
 
             def Av(v):
-                return hvp(x, v, key) + lam * v
+                return hvp(x, v, key, *data) + lam * v
 
             # Plain CG on A delta = -g (reference conjGradient :87)
             b = -g
@@ -323,7 +335,7 @@ class StochasticHessianFree(BaseOptimizer):
                                             (zeros, b, b))
 
             # Backtrack over the CG solution (reference cgBackTrack :184)
-            new_score = loss(x + delta, key)
+            new_score = loss(x + delta, key, *data)
 
             def shrink_cond(s):
                 scale, ns, it = s
@@ -332,7 +344,7 @@ class StochasticHessianFree(BaseOptimizer):
             def shrink_body(s):
                 scale, _, it = s
                 scale = scale * 0.5
-                return (scale, loss(x + scale * delta, key), it + 1)
+                return (scale, loss(x + scale * delta, key, *data), it + 1)
 
             scale, new_score, _ = jax.lax.while_loop(
                 shrink_cond, shrink_body,

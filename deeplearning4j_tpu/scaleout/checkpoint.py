@@ -125,6 +125,9 @@ class DefaultModelSaver(ModelSaver):
 
     def _write(self, payload: Dict[str, Any]) -> str:
         """Timestamp-rename any prior checkpoint, then atomically publish."""
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         if self.keep_old and os.path.exists(self.path):
             os.replace(self.path, f"{self.path}.{int(time.time() * 1000)}")
         tmp = f"{self.path}.tmp"
@@ -166,6 +169,54 @@ class DefaultModelSaver(ModelSaver):
         `load_checkpoint` when conf_json is provided."""
         return self._write(self._payload(
             conf_json=conf_json, params=params, metadata=metadata))
+
+
+class UriModelSaver(DefaultModelSaver):
+    """ModelSaver that treats its path as a storage URI.
+
+    Parity: reference HdfsModelSaver (hadoop/modelsaving/HdfsModelSaver.java
+    — checkpoint to a distributed filesystem path) and S3ModelSaver
+    (aws/s3/modelsaver/). The TPU-native artifact plane is GCS
+    (SURVEY §5): on a pod, `gs://` buckets are mounted via gcsfuse (or an
+    orbax saver is swapped in behind the same two methods), so remote
+    schemes resolve to a mount root and everything downstream is plain
+    file IO with the same atomic-rename discipline as DefaultModelSaver.
+
+    Supported schemes: `file://` (and bare paths), plus `gs://`, `s3://`,
+    `hdfs://` when `mounts` (or the DL4J_TPU_ARTIFACT_ROOT env var) maps
+    the scheme to a local mount point, e.g.
+    {"gs": "/mnt/gcs"} -> gs://bucket/run/ckpt => /mnt/gcs/bucket/run/ckpt.
+    """
+
+    REMOTE_SCHEMES = ("gs", "s3", "hdfs")
+
+    def __init__(self, uri: str, keep_old: bool = True,
+                 mounts: Optional[Dict[str, str]] = None):
+        self.uri = uri
+        mounts = dict(mounts or {})
+        env_root = os.environ.get("DL4J_TPU_ARTIFACT_ROOT")
+        if env_root:
+            for scheme in self.REMOTE_SCHEMES:
+                mounts.setdefault(scheme, env_root)
+        super().__init__(self._resolve(uri, mounts), keep_old=keep_old)
+
+    @classmethod
+    def _resolve(cls, uri: str, mounts: Dict[str, str]) -> str:
+        scheme, sep, rest = uri.partition("://")
+        if not sep:
+            return uri  # bare local path
+        if scheme == "file":
+            return rest if rest.startswith("/") else "/" + rest
+        if scheme in cls.REMOTE_SCHEMES:
+            root = mounts.get(scheme)
+            if not root:
+                raise ValueError(
+                    f"{scheme}:// checkpoint URI needs a mount point: pass "
+                    f"mounts={{'{scheme}': '/mnt/...'}} or set "
+                    f"DL4J_TPU_ARTIFACT_ROOT (no direct {scheme} client in "
+                    f"this environment)")
+            return os.path.join(root, rest)  # _write makedirs at save time
+        raise ValueError(f"Unknown checkpoint URI scheme: {scheme}://")
 
 
 def load_checkpoint(path: str):

@@ -1,0 +1,318 @@
+"""Distributed NLP performers: Word2Vec / GloVe / WordCount jobs over the
+scaleout runtime.
+
+Parity: reference nlp/scaleout/perform —
+`Word2VecPerformer` (Word2VecPerformer.java:88-140: train sentence jobs
+against shared syn0/syn1, alpha decayed from the tracker's
+NUM_WORDS_SO_FAR counter :91-:115, emit Word2VecResult DELTAS),
+`GlovePerformer` (GlovePerformer.java + GloveWork/GloveResult: co-occurrence
+batch jobs against shared w/c tables), and
+`WordCountWorkPerformer` + `WordCountJobAggregator` (scaleout/perform/text/:
+count words per job, Counter-merge aggregation).
+
+TPU-native design: each job trains a BATCH on-device via the same jitted
+steps the single-process models use (word2vec's HS/negative-sampling step,
+glove's AdaGrad weighted-LSQ step); only packed table vectors and small
+counters cross the control plane. Delta results (new - old tables) let the
+master apply averaged deltas onto the current model, so concurrent workers
+compose like the reference's hogwild-with-averaging instead of last-write-
+wins.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import Counter
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.scaleout.api import Job, JobAggregator, WorkerPerformer
+
+log = logging.getLogger(__name__)
+
+#: tracker counter key (reference Word2VecPerformer.NUM_WORDS_SO_FAR)
+NUM_WORDS_SO_FAR = "word2vec_num_words_so_far"
+
+
+class Word2VecWorkPerformer(WorkerPerformer):
+    """Train skip-gram on each job's sentence batch; result = table deltas.
+
+    conf keys: `vocab` (VocabCache.to_dict()), `layer_size`, `window`,
+    `negative`, `learning_rate`, `min_learning_rate`, `total_words`
+    (expected corpus words x iterations, drives alpha decay), `sample`,
+    `batch_pairs`, `seed`.
+    """
+
+    def __init__(self):
+        self._w2v = None
+        self._tracker = None
+        self.alpha0 = 0.025
+        self.min_alpha = 1e-4
+        self.total_words = 1.0
+
+    def bind_tracker(self, tracker) -> None:
+        """Runtime hook: the live StateTracker drives alpha decay
+        (reference Word2VecPerformer gets the tracker injected)."""
+        self._tracker = tracker
+
+    def setup(self, conf: Dict[str, Any]) -> None:
+        from deeplearning4j_tpu.nlp.vocab import VocabCache
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        self._w2v = Word2Vec(
+            layer_size=int(conf.get("layer_size", 100)),
+            window=int(conf.get("window", 5)),
+            negative=int(conf.get("negative", 0)),
+            learning_rate=float(conf.get("learning_rate", 0.025)),
+            min_learning_rate=float(conf.get("min_learning_rate", 1e-4)),
+            sample=float(conf.get("sample", 0.0)),
+            batch_pairs=int(conf.get("batch_pairs", 4096)),
+            seed=int(conf.get("seed", 123)),
+        )
+        self._w2v.vocab = VocabCache.from_dict(conf["vocab"])
+        from deeplearning4j_tpu.nlp.huffman import max_code_length
+        self._w2v._code_len = max(1, max_code_length(self._w2v.vocab))
+        self._w2v.reset_weights()
+        self.alpha0 = self._w2v.alpha
+        self.min_alpha = self._w2v.min_alpha
+        self.total_words = float(conf.get(
+            "total_words", self._w2v.vocab.total_word_count))
+        self._step = None
+        self._rng = np.random.RandomState(self._w2v.seed)
+
+    # ------------------------------------------------------------- packing
+    def _tables(self) -> Dict[str, Any]:
+        t = {"syn0": self._w2v.syn0}
+        if self._w2v.syn1 is not None:
+            t["syn1"] = self._w2v.syn1
+        if self._w2v.syn1neg is not None:
+            t["syn1neg"] = self._w2v.syn1neg
+        return t
+
+    def pack(self) -> np.ndarray:
+        return np.concatenate([np.asarray(v).ravel()
+                               for _, v in sorted(self._tables().items())])
+
+    def _install(self, packed: np.ndarray) -> None:
+        import jax.numpy as jnp
+        offset = 0
+        for name, v in sorted(self._tables().items()):
+            size = int(np.prod(np.asarray(v).shape))
+            chunk = packed[offset:offset + size].reshape(np.asarray(v).shape)
+            setattr(self._w2v, name, jnp.asarray(chunk))
+            offset += size
+
+    # ------------------------------------------------------------- perform
+    def perform(self, job: Job) -> None:
+        """job.work: list of sentences. Trains locally, result = delta."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nlp.sentence_iterator import (
+            CollectionSentenceIterator)
+
+        w2v = self._w2v
+        if w2v is None:
+            raise RuntimeError("setup() not called")
+        if self._step is None:
+            self._step = w2v._build_step()
+        sentences: List[str] = list(job.work)
+        w2v.sentence_iter = CollectionSentenceIterator(sentences)
+
+        before = self.pack()
+        tables = self._tables()
+        B = w2v.batch_pairs
+        words_in_job = 0
+        for centers, contexts, n_words in w2v._iter_pair_chunks(self._rng):
+            words_in_job += n_words
+            # alpha from the CLUSTER-WIDE words counter (reference :91)
+            so_far = (self._tracker.count(NUM_WORDS_SO_FAR)
+                      if self._tracker is not None else 0.0)
+            alpha = max(self.min_alpha,
+                        self.alpha0 * (1.0 - so_far / self.total_words))
+            for lo in range(0, centers.size, B):
+                bc, bx = centers[lo:lo + B], contexts[lo:lo + B]
+                if bc.size < B:  # static batch shape
+                    pad = np.arange(B - bc.size) % max(1, bc.size)
+                    bc = np.concatenate([bc, bc[pad]])
+                    bx = np.concatenate([bx, bx[pad]])
+                w2v._key, k = jax.random.split(w2v._key)
+                tables, _ = self._step(tables, jnp.asarray(bc),
+                                       jnp.asarray(bx), jnp.float32(alpha), k)
+        for name, v in tables.items():
+            setattr(w2v, name, v)
+        if self._tracker is not None and words_in_job:
+            self._tracker.increment(NUM_WORDS_SO_FAR, float(words_in_job))
+        job.result = self.pack() - before  # DELTA (reference Word2VecResult)
+
+    def update(self, *args: Any) -> None:
+        """Install the master's current packed tables."""
+        self._install(np.asarray(args[0]))
+
+    # convenience for tests / consumers
+    def word_vectors(self):
+        from deeplearning4j_tpu.nlp.word2vec import WordVectors
+        return WordVectors(self._w2v.vocab, np.asarray(self._w2v.syn0))
+
+
+class GloveWorkPerformer(WorkerPerformer):
+    """Train GloVe on each job's co-occurrence triple batch; result = delta.
+
+    conf keys: `vocab`, `layer_size`, `learning_rate`, `x_max`, `alpha`,
+    `seed`. job.work: dict {rows, cols, vals} index arrays.
+    """
+
+    def __init__(self):
+        self._params = None
+        self._accum = None
+        self._step = None
+        self.conf: Dict[str, Any] = {}
+
+    def setup(self, conf: Dict[str, Any]) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.conf = dict(conf)
+        v = len(conf["vocab"]["words"])
+        d = int(conf.get("layer_size", 50))
+        lr = float(conf.get("learning_rate", 0.05))
+        x_max = float(conf.get("x_max", 100.0))
+        alpha = float(conf.get("alpha", 0.75))
+        key = jax.random.PRNGKey(int(conf.get("seed", 123)))
+        kw, kc = jax.random.split(key)
+        self._params = {
+            "w": jax.random.uniform(kw, (v, d), jnp.float32, -0.5 / d,
+                                    0.5 / d),
+            "c": jax.random.uniform(kc, (v, d), jnp.float32, -0.5 / d,
+                                    0.5 / d),
+            "bw": jnp.zeros((v,), jnp.float32),
+            "bc": jnp.zeros((v,), jnp.float32),
+        }
+        self._accum = jax.tree_util.tree_map(
+            lambda p: jnp.full(p.shape, 1e-8, jnp.float32), self._params)
+
+        def loss_fn(params, r, c, x):
+            wr, wc = params["w"][r], params["c"][c]
+            pred = (jnp.sum(wr * wc, axis=1) + params["bw"][r]
+                    + params["bc"][c])
+            err = pred - jnp.log(x)
+            fx = jnp.minimum(1.0, (x / x_max) ** alpha)
+            return 0.5 * jnp.sum(fx * err * err) / r.shape[0]
+
+        @jax.jit
+        def step(params, accum, r, c, x):
+            loss, grads = jax.value_and_grad(loss_fn)(params, r, c, x)
+            accum = jax.tree_util.tree_map(lambda a, g: a + g * g, accum,
+                                           grads)
+            params = jax.tree_util.tree_map(
+                lambda p, g, a: p - lr * g / jnp.sqrt(a), params, grads,
+                accum)
+            return params, accum, loss
+
+        self._step = step
+
+    def pack(self) -> np.ndarray:
+        return np.concatenate([np.asarray(v).ravel()
+                               for _, v in sorted(self._params.items())])
+
+    def _install(self, packed: np.ndarray) -> None:
+        import jax.numpy as jnp
+        offset = 0
+        for name in sorted(self._params):
+            shape = self._params[name].shape
+            size = int(np.prod(shape))
+            self._params[name] = jnp.asarray(
+                packed[offset:offset + size].reshape(shape))
+            offset += size
+
+    def perform(self, job: Job) -> None:
+        import jax.numpy as jnp
+
+        if self._step is None:
+            raise RuntimeError("setup() not called")
+        work = job.work
+        before = self.pack()
+        self._params, self._accum, loss = self._step(
+            self._params, self._accum,
+            jnp.asarray(np.asarray(work["rows"], np.int32)),
+            jnp.asarray(np.asarray(work["cols"], np.int32)),
+            jnp.asarray(np.asarray(work["vals"], np.float32)))
+        job.result = self.pack() - before
+
+    def update(self, *args: Any) -> None:
+        self._install(np.asarray(args[0]))
+
+
+class WordCountWorkPerformer(WorkerPerformer):
+    """Count words in each job's sentence batch (reference
+    WordCountWorkPerformer — the distributed vocab-building primitive)."""
+
+    def __init__(self):
+        from deeplearning4j_tpu.nlp.tokenization import (
+            DefaultTokenizerFactory)
+        self.tokenizer_factory = DefaultTokenizerFactory()
+
+    def setup(self, conf: Dict[str, Any]) -> None:
+        pass
+
+    def perform(self, job: Job) -> None:
+        counts: Counter = Counter()
+        for sentence in job.work:
+            counts.update(self.tokenizer_factory.tokenize(sentence))
+        job.result = dict(counts)
+
+    def update(self, *args: Any) -> None:
+        pass
+
+
+class WordCountJobAggregator(JobAggregator):
+    """Counter-merge aggregation (reference WordCountJobAggregator): wave
+    counts merge INTO the running totals held as the current model."""
+
+    def __init__(self):
+        self.counts: Counter = Counter()
+
+    def accumulate(self, job: Job) -> None:
+        if job.result:
+            self.counts.update(job.result)
+
+    def aggregate(self) -> Optional[Dict[str, float]]:
+        return dict(self.counts) if self.counts else None
+
+    @staticmethod
+    def apply(current, aggregated) -> Dict[str, float]:
+        merged = Counter(current or {})
+        merged.update(aggregated)
+        return dict(merged)
+
+
+class DeltaAveragingAggregator(JobAggregator):
+    """Average delta vectors; publication applies `current + mean(delta)`
+    (reference Word2VecJobAggregator semantics over Word2VecResult)."""
+
+    def __init__(self):
+        self._sum: Optional[np.ndarray] = None
+        self._n = 0
+
+    def accumulate(self, job: Job) -> None:
+        if job.result is None:
+            return
+        r = np.asarray(job.result, np.float64)
+        self._sum = r if self._sum is None else self._sum + r
+        self._n += 1
+
+    def aggregate(self) -> Optional[np.ndarray]:
+        if self._sum is None:
+            return None
+        return (self._sum / self._n).astype(np.float32)
+
+    @staticmethod
+    def apply(current, aggregated) -> np.ndarray:
+        if current is None:
+            # publishing a bare delta would replace every worker's init
+            # with near-zero garbage on the first replication
+            raise ValueError(
+                "DeltaAveragingAggregator needs the runtime constructed "
+                "with initial_params (deltas apply onto a current model)")
+        return np.asarray(current) + aggregated

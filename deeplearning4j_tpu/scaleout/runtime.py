@@ -60,6 +60,8 @@ class _Worker(threading.Thread):
 
     def run(self):
         tracker, wid = self.tracker, self.worker_id
+        if hasattr(self.performer, "bind_tracker"):
+            self.performer.bind_tracker(tracker)  # e.g. w2v alpha counter
         tracker.add_worker(wid)
         while not tracker.is_done():
             if self.paused.is_set():
@@ -112,11 +114,15 @@ class DistributedRuntime:
         model_saver=None,
         save_every_waves: int = 0,
         initial_params: Optional[np.ndarray] = None,
+        aggregator_factory: Optional[Callable] = None,
     ):
         self.job_iterator = job_iterator
         self.tracker = tracker or InMemoryStateTracker()
         self.n_workers = n_workers
-        self.performers = [performer_factory() for _ in range(n_workers)]
+        # performer_factory=None => workers live in other processes
+        # (MultiProcessMaster) and bring their own performers
+        self.performers = ([performer_factory() for _ in range(n_workers)]
+                           if performer_factory is not None else [])
         self.router = (router_cls or IterativeReduceWorkRouter)(self.tracker)
         # Declarative router policy: barrier-style routers aggregate in
         # waves; async routers merge updates as they arrive, with
@@ -126,6 +132,8 @@ class DistributedRuntime:
         self.model_saver = model_saver
         self.save_every_waves = save_every_waves
         self.workers: List[_Worker] = []
+        self.aggregator_factory = (aggregator_factory
+                                   or ParameterAveragingAggregator)
         self.waves = 0
         self._orphan_jobs: List[Job] = []  # evicted workers' in-flight jobs
         if initial_params is not None:
@@ -173,7 +181,7 @@ class DistributedRuntime:
         snapshot = self.tracker.worker_updates()
         if not snapshot:
             return
-        agg = ParameterAveragingAggregator()
+        agg = self.aggregator_factory()
         for wid in snapshot:
             update = self.tracker.load_update(wid)
             if update is not None:
@@ -182,7 +190,11 @@ class DistributedRuntime:
         if averaged is None:
             return
         current = self.tracker.get_current()
-        if current is not None and self.sync:
+        if hasattr(agg, "apply"):
+            # aggregators with custom publication semantics (delta
+            # application, counter merge — the distributed NLP performers)
+            new = agg.apply(current, averaged)
+        elif current is not None and self.sync:
             # epoch-wave averaging: replace (all replicas started from
             # `current`, so the average IS the merged model)
             new = averaged
@@ -204,7 +216,9 @@ class DistributedRuntime:
         """Checkpoint the current averaged model (reference ModelSavingActor
         "save" topic). The saver's save_current gets the packed params plus
         the conf JSON so the checkpoint is self-describing."""
-        conf_json = getattr(self.performers[0], "conf_json", None)
+        conf_json = getattr(self, "conf_json", None)
+        if conf_json is None and self.performers:
+            conf_json = getattr(self.performers[0], "conf_json", None)
         self.model_saver.save_current(
             self.tracker.get_current(), conf_json=conf_json,
             metadata={"waves": self.waves})

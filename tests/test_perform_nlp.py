@@ -1,0 +1,167 @@
+"""Distributed NLP performer tests (reference DistributedWord2VecTest /
+DistributedGloveTest / WordCountTest, which run the full runtime with an
+embedded tracker in one process — same tier here)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.huffman import build_huffman
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache, build_vocab
+from deeplearning4j_tpu.nlp.word2vec import WordVectors
+from deeplearning4j_tpu.scaleout.api import CollectionJobIterator
+from deeplearning4j_tpu.scaleout.perform_nlp import (
+    NUM_WORDS_SO_FAR,
+    DeltaAveragingAggregator,
+    GloveWorkPerformer,
+    Word2VecWorkPerformer,
+    WordCountJobAggregator,
+    WordCountWorkPerformer,
+)
+from deeplearning4j_tpu.scaleout.runtime import DistributedRuntime
+
+
+def topic_sentences(n_reps=30):
+    base = [
+        "the cat sat on the mat",
+        "the dog sat on the rug",
+        "the cat and the dog play in the yard",
+        "a furry cat chases a furry dog",
+        "the king wears the crown in the castle",
+        "the queen wears the crown in the castle",
+        "a royal king and a royal queen sit on the throne",
+    ]
+    return base * n_reps
+
+
+def built_vocab(sentences, min_freq=3.0):
+    cache = build_vocab(sentences, DefaultTokenizerFactory(), min_freq)
+    build_huffman(cache)
+    return cache
+
+
+class TestDistributedWord2Vec:
+    def test_two_workers_learn_topic_structure(self):
+        """DistributedWord2VecTest equivalent: sentence jobs fan out over
+        the runtime, averaged deltas land on the current model."""
+        sentences = topic_sentences()
+        vocab = built_vocab(sentences)
+        conf = {"vocab": vocab.to_dict(), "layer_size": 32, "window": 3,
+                "negative": 0, "learning_rate": 0.1,
+                "total_words": vocab.total_word_count * 4,
+                "batch_pairs": 512, "seed": 7}
+        # jobs = sentence batches, several passes (reference sentence jobs)
+        batches = [sentences[i:i + 35]
+                   for i in range(0, len(sentences), 35)] * 4
+
+        seed_performer = Word2VecWorkPerformer()
+        seed_performer.setup(conf)
+        initial = seed_performer.pack()
+
+        runtime = DistributedRuntime(
+            CollectionJobIterator(batches),
+            performer_factory=lambda: _fresh_performer(conf),
+            n_workers=2,
+            aggregator_factory=DeltaAveragingAggregator,
+            initial_params=initial,
+        )
+        final = runtime.run(timeout=300.0)
+        assert final is not None and final.shape == initial.shape
+        # the words counter drove alpha decay
+        assert runtime.tracker.count(NUM_WORDS_SO_FAR) > 0
+        # install the final averaged tables and check embedding quality
+        seed_performer.update(final)
+        wv = seed_performer.word_vectors()
+        assert wv.similarity("cat", "dog") > wv.similarity("cat", "king")
+
+    def test_delta_results_not_full_tables(self):
+        sentences = topic_sentences(5)
+        vocab = built_vocab(sentences)
+        conf = {"vocab": vocab.to_dict(), "layer_size": 16, "window": 3,
+                "negative": 0, "learning_rate": 0.05,
+                "total_words": vocab.total_word_count, "batch_pairs": 256,
+                "seed": 1}
+        performer = Word2VecWorkPerformer()
+        performer.setup(conf)
+        before = performer.pack()
+        from deeplearning4j_tpu.scaleout.api import Job
+        job = Job(work=sentences[:20], worker_id="w0")
+        performer.perform(job)
+        # result is the delta, so before + delta == after
+        np.testing.assert_allclose(before + job.result, performer.pack(),
+                                   atol=1e-5)
+        assert np.abs(job.result).max() > 0  # training moved something
+
+
+def _fresh_performer(conf):
+    p = Word2VecWorkPerformer()
+    p.setup(conf)
+    return p
+
+
+class TestDistributedGlove:
+    def test_delta_training_reduces_loss(self):
+        sentences = topic_sentences()
+        vocab = built_vocab(sentences)
+        from deeplearning4j_tpu.nlp.glove import CoOccurrences
+        from deeplearning4j_tpu.nlp.sentence_iterator import (
+            CollectionSentenceIterator)
+        co = CoOccurrences(CollectionSentenceIterator(sentences),
+                           DefaultTokenizerFactory(), vocab, window=3).calc()
+        rows, cols, vals = co.triples()
+        rng = np.random.RandomState(0)
+        conf = {"vocab": vocab.to_dict(), "layer_size": 16,
+                "learning_rate": 0.05, "seed": 3}
+
+        def glove_jobs(n_jobs=12, size=256):
+            out = []
+            for _ in range(n_jobs):
+                sel = rng.randint(0, rows.size, size)
+                out.append({"rows": rows[sel], "cols": cols[sel],
+                            "vals": vals[sel]})
+            return out
+
+        seed_perf = GloveWorkPerformer()
+        seed_perf.setup(conf)
+        initial = seed_perf.pack()
+
+        def make():
+            p = GloveWorkPerformer()
+            p.setup(conf)
+            return p
+
+        runtime = DistributedRuntime(
+            CollectionJobIterator(glove_jobs()),
+            performer_factory=make, n_workers=2,
+            aggregator_factory=DeltaAveragingAggregator,
+            initial_params=initial)
+        final = runtime.run(timeout=300.0)
+        assert final is not None
+
+        # weighted-LSQ loss of the averaged tables < initial tables
+        def glove_loss(packed, perf):
+            perf._install(packed)
+            p = perf._params
+            w = np.asarray(p["w"])[rows]
+            c = np.asarray(p["c"])[cols]
+            pred = ((w * c).sum(1) + np.asarray(p["bw"])[rows]
+                    + np.asarray(p["bc"])[cols])
+            err = pred - np.log(vals)
+            fx = np.minimum(1.0, vals / 100.0) ** 0.75
+            return float(0.5 * np.mean(fx * err * err))
+
+        assert glove_loss(final, seed_perf) < glove_loss(initial, seed_perf)
+
+
+class TestWordCount:
+    def test_counter_merge_aggregation(self):
+        """WordCountTest equivalent: per-job counts, Counter-merge."""
+        sentences = ["the cat", "the dog", "a cat"]
+        jobs = [[s] for s in sentences]
+        runtime = DistributedRuntime(
+            CollectionJobIterator(jobs),
+            performer_factory=WordCountWorkPerformer,
+            n_workers=2,
+            aggregator_factory=WordCountJobAggregator)
+        final = runtime.run(timeout=60.0)
+        assert final == {"the": 2, "cat": 2, "dog": 1, "a": 1}
