@@ -97,8 +97,16 @@ def cmd_test(args) -> int:
 
 
 def cmd_predict(args) -> int:
-    x, _ = _load_csv(args.input, 0)
+    # default 0: predict input is normally features-only; pass
+    # --label-columns 1 to reuse a labelled train/test CSV
+    x, _ = _load_csv(args.input, args.label_columns)
     net = _load_model(args.model)
+    n_in = net.conf.confs[0].n_in
+    if n_in and x.shape[1] != n_in:
+        print(f"input has {x.shape[1]} feature columns but the model "
+              f"expects {n_in}; use --label-columns to drop trailing "
+              f"label column(s)", file=sys.stderr)
+        return 2
     preds = net.predict(x)
     if args.output:
         np.savetxt(args.output, preds, fmt="%d")
@@ -136,7 +144,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_pred = sub.add_parser("predict", help="emit class predictions")
     common(p_pred, False)
-    p_pred.set_defaults(fn=cmd_predict)
+    p_pred.set_defaults(fn=cmd_predict, label_columns=0)
     return parser
 
 

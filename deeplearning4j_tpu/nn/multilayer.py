@@ -48,6 +48,7 @@ class MultiLayerNetwork:
         self._train_step = None
         self._finetune_solver = None
         self._batch_solver = None
+        self._pretrain_solvers: Dict[int, Solver] = {}
         self._pending_params = params
         self._iteration_count = 0
         self.listeners: List = []
@@ -84,6 +85,7 @@ class MultiLayerNetwork:
         self._train_step = None
         self._finetune_solver = None
         self._batch_solver = None
+        self._pretrain_solvers = {}
         if self._pending_params is not None:
             self.set_parameters(self._pending_params)
             self._pending_params = None
@@ -169,17 +171,24 @@ class MultiLayerNetwork:
         for i, layer in enumerate(self.layers[:-1]):
             if not hasattr(layer, "pretrain_loss"):
                 continue
-            # One solver per layer: the batch is a traced argument of the
-            # jitted step, so every mini-batch of this layer's phase reuses
-            # ONE compiled program instead of recompiling per batch
-            _, unravel_i = ravel_pytree(self._params[str(i)])
+            # One solver per layer, cached across pretrain() calls: the
+            # batch is a traced argument of the jitted step, so every
+            # mini-batch of this layer's phase (and every later pretrain
+            # pass) reuses ONE compiled program instead of recompiling
+            solver = self._pretrain_solvers.get(i)
+            if solver is None:
+                _, unravel_i = ravel_pytree(self._params[str(i)])
 
-            def flat_loss(vec, key, batch, *, _l=layer, _u=unravel_i):
-                return _l.pretrain_loss(_u(vec), batch, key)
+                def flat_loss(vec, key, batch, *, _l=layer, _u=unravel_i):
+                    return _l.pretrain_loss(_u(vec), batch, key)
 
-            solver = Solver(layer.conf, flat_loss,
-                            listeners=self.listeners, model=self,
-                            rng_key=self.next_key())
+                solver = Solver(layer.conf, flat_loss,
+                                listeners=self.listeners, model=self,
+                                rng_key=self.next_key())
+                self._pretrain_solvers[i] = solver
+            # the optimizer snapshots its listener list; refresh it so
+            # set_listeners() calls between fits reach cached solvers
+            solver.get_optimizer().listeners = list(self.listeners)
             for x in self._iter_batches(data):
                 cur = x
                 for j in range(i):

@@ -4,4 +4,7 @@ from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
     IterationListener,
     ScoreIterationListener,
     ComposableIterationListener,
+    CollectScoresListener,
+    StepTimeListener,
+    ProfilerListener,
 )

@@ -3,12 +3,20 @@
 Parity: reference core/optimize/api/IterationListener.java (hook invoked from
 BaseOptimizer.java:168-170), ScoreIterationListener (listeners/
 ScoreIterationListener.java:41), ComposableIterationListener.
+
+Beyond parity (SURVEY §5 tracing/profiling): the reference had nothing past
+SLF4J score logging; the TPU equivalents are `StepTimeListener` (wall-clock
+step-time metrics with summary stats) and `ProfilerListener` (toggles a
+jax.profiler trace for a window of iterations so steps can be inspected in
+xprof/TensorBoard).
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Iterable
+import statistics
+import time
+from typing import Iterable, Optional
 
 log = logging.getLogger(__name__)
 
@@ -44,3 +52,103 @@ class CollectScoresListener(IterationListener):
 
     def iteration_done(self, model, iteration: int, score: float) -> None:
         self.scores.append((iteration, float(score)))
+
+
+class StepTimeListener(IterationListener):
+    """Wall-clock time between consecutive iterations.
+
+    The reference's listener tier stops at score printing
+    (ScoreIterationListener.java:41); on TPU the first-class observability
+    signal is step time — it is what the dispatch/compile/HBM story shows up
+    in. Times are measured listener-to-listener, so they include everything
+    in a step (grad, update, host sync), not just device compute.
+    """
+
+    def __init__(self, log_every: int = 0):
+        self.log_every = log_every
+        self.step_times: list = []
+        self._last: Optional[float] = None
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            dt = now - self._last
+            self.step_times.append(dt)
+            if self.log_every and len(self.step_times) % self.log_every == 0:
+                log.info("step %d: %.3f ms", iteration, dt * 1e3)
+        self._last = now
+
+    def reset(self) -> None:
+        self.step_times.clear()
+        self._last = None
+
+    def optimization_done(self, model) -> None:
+        """Solver hook: the gap between two optimize() runs (batch prep,
+        next phase's compile) is not a step — don't time across it."""
+        self._last = None
+
+    def summary(self) -> dict:
+        """{count, mean_ms, median_ms, p90_ms, max_ms} over recorded steps."""
+        if not self.step_times:
+            return {"count": 0}
+        ms = sorted(t * 1e3 for t in self.step_times)
+        return {
+            "count": len(ms),
+            "mean_ms": statistics.fmean(ms),
+            "median_ms": statistics.median(ms),
+            "p90_ms": ms[min(len(ms) - 1, int(0.9 * len(ms)))],
+            "max_ms": ms[-1],
+        }
+
+
+class ProfilerListener(IterationListener):
+    """Toggle a jax.profiler trace over iterations [start, stop).
+
+    Writes an xprof-compatible trace to `log_dir` covering the chosen
+    iteration window (skipping iteration 0 by default — that is where
+    compilation lands and it would swamp the steady-state trace). Because
+    the listener hook fires AFTER each iteration, the trace is started once
+    iteration `start - 1` has completed, so device work for iterations
+    [start, stop) is captured. If optimization terminates before the window
+    closes, `optimization_done` stops the trace deterministically.
+    """
+
+    def __init__(self, log_dir: str, start: int = 1, stop: int = 4):
+        if stop <= start:
+            raise ValueError(f"stop ({stop}) must be > start ({start})")
+        if start < 1:
+            raise ValueError("start must be >= 1 (the hook fires after "
+                             "each iteration; iteration 0 cannot be traced)")
+        self.log_dir = log_dir
+        self.start = start
+        self.stop = stop
+        self._active = False
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        import jax
+
+        if (not self._active and self.start - 1 <= iteration < self.stop - 1):
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and iteration >= self.stop - 1:
+            self._stop_trace()
+
+    def optimization_done(self, model) -> None:
+        """Solver hook: close an open trace when the loop ends early."""
+        if self._active:
+            self._stop_trace()
+
+    def _stop_trace(self) -> None:
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._active = False
+
+    def __del__(self):
+        if getattr(self, "_active", False):
+            try:
+                self._stop_trace()
+            except Exception:
+                pass

@@ -108,6 +108,13 @@ class BaseOptimizer:
                 log.debug("Terminated at iteration %d (score=%s)", i, score)
                 break
             old_score = score
+        for listener in self.listeners:
+            # end-of-optimization hook (beyond-parity: lets stateful
+            # listeners like ProfilerListener finalize deterministically
+            # even when a termination condition cuts the loop short)
+            done = getattr(listener, "optimization_done", None)
+            if done is not None:
+                done(self.model)
         return unravel(x), score
 
 

@@ -92,7 +92,7 @@ class MultiProcessMaster(DistributedRuntime):
             return super().run(timeout=timeout)
         finally:
             self.server.stop()
-            self.registry.unregister(f"run-{self.run_name}", 0)
+            self.registry.unregister_run(self.run_name)
 
 
 def run_worker(*, registry_root: str, run_name: str, worker_id: str,
@@ -113,11 +113,15 @@ def run_worker(*, registry_root: str, run_name: str, worker_id: str,
              conf[TRACKER_ADDRESS])
     try:
         worker.run()  # blocks until tracker.is_done()
-    except (ConnectionError, RuntimeError) as e:
-        # master gone = shutdown signal for a remote worker
+    except ConnectionError as e:
+        # master gone = shutdown signal for a remote worker. Server-side
+        # tracker failures surface as RuntimeError and must NOT be
+        # swallowed as a clean exit — let them propagate to a nonzero
+        # process exit so the launcher/test harness sees the failure.
         log.info("worker %s: master connection lost (%s), exiting", worker_id,
                  e)
-    tracker.close()
+    finally:
+        tracker.close()
     return worker.performed
 
 

@@ -98,3 +98,6 @@ class ConfigRegistry:
         if timeout:
             return self.wait_for(f"run-{run_name}", 0, timeout)
         return self.retrieve(f"run-{run_name}", 0)
+
+    def unregister_run(self, run_name: str) -> None:
+        self.unregister(f"run-{run_name}", 0)

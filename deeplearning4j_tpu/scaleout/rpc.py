@@ -26,6 +26,7 @@ import socket
 import socketserver
 import struct
 import threading
+import uuid
 from typing import Any, Optional, Tuple
 
 import numpy as np
@@ -112,11 +113,29 @@ def _recv_frame(sock: socket.socket) -> dict:
 class _TrackerHandler(socketserver.BaseRequestHandler):
     def handle(self):
         tracker = self.server.tracker  # type: ignore[attr-defined]
+        dedup = self.server.dedup  # type: ignore[attr-defined]
+        dedup_lock = self.server.dedup_lock  # type: ignore[attr-defined]
         while True:
             try:
                 req = _recv_frame(self.request)
             except (ConnectionError, OSError):
                 return
+            # At-most-once execution: a client that lost the connection
+            # after the server executed its call re-sends the SAME
+            # (client, seq); replay the cached response instead of
+            # re-executing non-idempotent methods (increment, add_update).
+            # Clients serialize calls, so one cached entry per client
+            # suffices.
+            client, seq = req.get("client"), req.get("seq")
+            if client is not None:
+                with dedup_lock:
+                    cached = dedup.get(client)
+                if cached is not None and cached[0] == seq:
+                    try:
+                        _send_frame(self.request, cached[1])
+                        continue
+                    except (ConnectionError, OSError):
+                        return
             try:
                 method = req.get("method")
                 if method not in ALLOWED_METHODS:
@@ -127,6 +146,9 @@ class _TrackerHandler(socketserver.BaseRequestHandler):
                 log.exception("tracker RPC %s failed", req.get("method"))
                 resp = {"ok": False,
                         "error": f"{type(e).__name__}: {e}"}
+            if client is not None:
+                with dedup_lock:
+                    dedup[client] = (seq, resp)
             try:
                 _send_frame(self.request, resp)
             except (ConnectionError, OSError):
@@ -146,6 +168,8 @@ class StateTrackerServer:
 
         self._server = _Server((host, port), _TrackerHandler)
         self._server.tracker = tracker  # type: ignore[attr-defined]
+        self._server.dedup = {}  # type: ignore[attr-defined]
+        self._server.dedup_lock = threading.Lock()  # type: ignore[attr-defined]
         self.host, self.port = self._server.server_address[:2]
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="tracker-server",
@@ -179,6 +203,8 @@ class RemoteStateTracker:
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self.heartbeat_timeout = None  # server decides staleness
+        self._client_id = uuid.uuid4().hex  # at-most-once dedup identity
+        self._seq = 0
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection(self._addr, timeout=self._timeout)
@@ -187,13 +213,17 @@ class RemoteStateTracker:
 
     def _call(self, method: str, *args: Any) -> Any:
         with self._lock:
+            self._seq += 1  # same seq across retries of THIS call: the
+            # server replays its cached response instead of re-executing
             last_err: Optional[Exception] = None
             for _ in range(self._retries):
                 try:
                     if self._sock is None:
                         self._sock = self._connect()
                     _send_frame(self._sock, {"method": method,
-                                             "args": list(args)})
+                                             "args": list(args),
+                                             "client": self._client_id,
+                                             "seq": self._seq})
                     resp = _recv_frame(self._sock)
                     break
                 except (ConnectionError, OSError) as e:

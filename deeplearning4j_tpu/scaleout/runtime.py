@@ -42,6 +42,10 @@ from deeplearning4j_tpu.scaleout.statetracker import InMemoryStateTracker
 
 log = logging.getLogger(__name__)
 
+#: tracker counter bumped by workers when a job exhausts its retries, so the
+#: master's wave barrier can stop waiting for it
+JOBS_DROPPED = "_jobs_dropped"
+
 
 class _Worker(threading.Thread):
     """Worker loop (reference WorkerActor.java:166-215 heartbeat body)."""
@@ -89,6 +93,9 @@ class _Worker(threading.Thread):
                     else:
                         log.error("dropping job for %s after %d retries",
                                   wid, job.retries)
+                        # the master's exact wave barrier must not wait for
+                        # an update that will never come
+                        tracker.increment(JOBS_DROPPED)
             else:
                 time.sleep(self.interval)
 
@@ -136,6 +143,13 @@ class DistributedRuntime:
                                    or ParameterAveragingAggregator)
         self.waves = 0
         self._orphan_jobs: List[Job] = []  # evicted workers' in-flight jobs
+        # Exact wave membership (reference IterativeReduceWorkRouter.java:46-57
+        # barrier): number of jobs dispatched into the current wave. The wave
+        # completes only when that many updates arrived — an eviction mid-wave
+        # re-forms the wave (its orphan job is re-served to a live worker and
+        # the barrier keeps waiting) instead of silently shrinking it.
+        self._wave_size = 0
+        self._wave_dropped_base = 0  # JOBS_DROPPED count when wave opened
         if initial_params is not None:
             self.tracker.set_current(np.asarray(initial_params))
 
@@ -152,14 +166,17 @@ class DistributedRuntime:
         return [w for w in self.tracker.workers()
                 if w not in assigned and w not in pending]
 
-    def _dispatch_wave(self) -> int:
+    def _dispatch_wave(self, orphans_only: bool = False) -> int:
+        """Hand jobs to free workers. `orphans_only` re-serves evicted
+        members' jobs into an OPEN wave without pulling new work from the
+        iterator (the re-formed wave keeps its original membership)."""
         sent = 0
         for wid in self._free_workers():
             if self._orphan_jobs:  # re-serve evicted workers' jobs first
                 job = self._orphan_jobs.pop()
                 job.worker_id = wid
                 job.result = None
-            elif self.job_iterator.has_next():
+            elif not orphans_only and self.job_iterator.has_next():
                 try:
                     job = self.job_iterator.next(wid)
                 except StopIteration:
@@ -172,6 +189,65 @@ class DistributedRuntime:
 
     def _has_work(self) -> bool:
         return bool(self._orphan_jobs) or self.job_iterator.has_next()
+
+    def _open_wave(self) -> int:
+        """Dispatch a new wave and record its exact membership size."""
+        self._wave_dropped_base = self.tracker.count(JOBS_DROPPED)
+        self._wave_size = self._dispatch_wave()
+        return self._wave_size
+
+    def _sync_tick(self, n_updates: int, n_outstanding: int) -> bool:
+        """One master poll in iterative-reduce mode; True => job stream
+        drained (stop). Exact wave barrier (reference
+        IterativeReduceWorkRouter.java:46-57)."""
+        if self._wave_size:
+            # Open wave: first re-serve any evicted member's job to a
+            # live worker (wave re-forms), then hold the barrier until
+            # EVERY dispatched job has reported — exact membership,
+            # not "whatever jobs happen to remain".
+            if self._orphan_jobs:
+                sent = self._dispatch_wave(orphans_only=True)
+                if not sent and not n_outstanding:
+                    # Every surviving member has reported and nobody is
+                    # free to take the orphan (live workers all hold
+                    # pending updates; re-dispatching to one would
+                    # overwrite its update). Close the wave on the
+                    # survivors and carry the orphan into the next wave —
+                    # it is served first there — instead of spinning
+                    # until the run timeout.
+                    log.warning(
+                        "wave of %d: %d orphan job(s) undeliverable, "
+                        "closing wave on survivors and carrying them over",
+                        self._wave_size, len(self._orphan_jobs))
+                    self._aggregate_and_publish()
+                    self._wave_size = 0
+            elif self._wave_complete(n_updates, n_outstanding):
+                self._aggregate_and_publish()
+                self._wave_size = 0
+        elif n_updates and not n_outstanding:
+            # stray updates with no open wave — e.g. an evicted worker
+            # re-registered and completed its old job after the wave it
+            # belonged to already closed. Fold them in (at-least-once
+            # semantics; averaging tolerates the duplicate batch) so the
+            # loop can't livelock on an update nobody is waiting for.
+            self._aggregate_and_publish()
+        elif not n_updates and not n_outstanding:
+            if not self._has_work():
+                return True
+            self._open_wave()
+        return False
+
+    def _wave_complete(self, n_updates: int, n_outstanding: int) -> bool:
+        """True when every job dispatched into the current wave has either
+        reported an update or been dropped after exhausting retries.
+        Evicted members don't shrink the wave: their orphan jobs are
+        re-served (`_dispatch_wave(orphans_only=True)`) and the barrier
+        keeps waiting for their updates."""
+        if n_outstanding or self._orphan_jobs:
+            return False
+        dropped = (self.tracker.count(JOBS_DROPPED)
+                   - getattr(self, "_wave_dropped_base", 0))
+        return n_updates + dropped >= self._wave_size
 
     def _aggregate_and_publish(self):
         """Average pending updates into the new global model (reference
@@ -252,13 +328,8 @@ class DistributedRuntime:
             n_updates = len(self.tracker.worker_updates())
             n_outstanding = len(self.tracker.jobs())
             if self.sync:
-                # wave barrier: aggregate when all outstanding jobs reported
-                if n_updates and not n_outstanding:
-                    self._aggregate_and_publish()
-                elif not n_updates and not n_outstanding:
-                    if not self._has_work():
-                        break
-                    self._dispatch_wave()
+                if self._sync_tick(n_updates, n_outstanding):
+                    break
             else:
                 if n_updates:
                     self._aggregate_and_publish()

@@ -88,3 +88,35 @@ def test_eps_termination_stops_early():
                                   Norm2Termination(1e-8)])
     solver.optimize(jnp.zeros(4))
     assert len(listener.scores) < 500
+
+
+def test_step_time_listener_summary():
+    from deeplearning4j_tpu.optimize.listeners import StepTimeListener
+
+    conf = NeuralNetConfiguration(optimization_algo="iteration_gradient_descent",
+                                  num_iterations=8, lr=0.1)
+    listener = StepTimeListener()
+    solver = Solver(conf, quadratic, listeners=[listener], terminations=[])
+    solver.optimize(jnp.zeros(4))
+    # n iterations -> n-1 listener-to-listener intervals
+    summary = listener.summary()
+    assert summary["count"] == 7
+    assert summary["median_ms"] >= 0.0
+    assert summary["max_ms"] >= summary["median_ms"] >= 0.0
+    listener.reset()
+    assert listener.summary() == {"count": 0}
+
+
+def test_profiler_listener_writes_trace(tmp_path):
+    from deeplearning4j_tpu.optimize.listeners import ProfilerListener
+
+    conf = NeuralNetConfiguration(optimization_algo="iteration_gradient_descent",
+                                  num_iterations=6, lr=0.1)
+    listener = ProfilerListener(str(tmp_path), start=1, stop=3)
+    solver = Solver(conf, quadratic, listeners=[listener], terminations=[])
+    solver.optimize(jnp.zeros(4))
+    assert not listener._active  # trace was stopped
+    # jax writes plugins/profile/<ts>/ under the log dir
+    found = [p for p, _, files in __import__("os").walk(tmp_path)
+             if any(f.endswith((".xplane.pb", ".trace.json.gz")) for f in files)]
+    assert found, "no profiler trace written"

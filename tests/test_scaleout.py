@@ -186,6 +186,79 @@ class TestDistributedRuntime:
         tracker.finish()
 
 
+class TestWaveMembership:
+    """Exact wave barrier (reference IterativeReduceWorkRouter.java:46-57):
+    an eviction mid-wave must re-form the wave, not silently shrink it."""
+
+    def _runtime(self, jobs):
+        it = CollectionJobIterator(jobs)
+        tracker = InMemoryStateTracker(heartbeat_timeout=1e9)
+        return DistributedRuntime(it, None, n_workers=2,
+                                  tracker=tracker), tracker
+
+    def test_wave_reforms_after_mid_wave_eviction(self):
+        rt, tracker = self._runtime([np.ones(3), 2 * np.ones(3)])
+        tracker.add_worker("a")
+        tracker.add_worker("b")
+        assert rt._open_wave() == 2
+
+        # b finishes its job; a is evicted mid-wave with its job in flight
+        job_b = tracker.job_for("b")
+        tracker.add_update("b", np.asarray(job_b.work))
+        tracker.clear_job("b")
+        orphan = tracker.remove_worker("a")
+        rt._orphan_jobs.append(Job(work=orphan.work,
+                                   worker_id=orphan.worker_id))
+
+        # barrier must hold: 1 update < wave of 2, orphan pending
+        assert not rt._wave_complete(len(tracker.worker_updates()),
+                                     len(tracker.jobs()))
+
+        # a live worker joins; the orphan job is re-served to it (the wave
+        # re-forms with its original membership)
+        tracker.add_worker("c")
+        rt._dispatch_wave(orphans_only=True)
+        assert not rt._orphan_jobs
+        job_c = tracker.job_for("c")
+        assert job_c is not None
+        np.testing.assert_allclose(job_c.work, orphan.work)
+        assert not rt._wave_complete(len(tracker.worker_updates()),
+                                     len(tracker.jobs()))
+
+        # only when the re-served job reports does the wave complete
+        tracker.add_update("c", np.asarray(job_c.work))
+        tracker.clear_job("c")
+        assert rt._wave_complete(len(tracker.worker_updates()),
+                                 len(tracker.jobs()))
+        rt._aggregate_and_publish()
+        np.testing.assert_allclose(tracker.get_current(), 1.5 * np.ones(3))
+
+    def test_orphans_only_dispatch_pulls_no_new_work(self):
+        rt, tracker = self._runtime([np.ones(3), 2 * np.ones(3),
+                                     3 * np.ones(3)])
+        tracker.add_worker("a")
+        assert rt._open_wave() == 1  # one free worker -> wave of 1
+        tracker.clear_job("a")  # a's job cleared; a is free again
+        # mid-wave orphan re-serve must not pull new work from the iterator
+        assert rt._dispatch_wave(orphans_only=True) == 0
+        assert rt.job_iterator.has_next()
+
+    def test_dropped_job_releases_barrier(self):
+        from deeplearning4j_tpu.scaleout.runtime import JOBS_DROPPED
+        rt, tracker = self._runtime([np.ones(3), 2 * np.ones(3)])
+        tracker.add_worker("a")
+        tracker.add_worker("b")
+        assert rt._open_wave() == 2
+        job_b = tracker.job_for("b")
+        tracker.add_update("b", np.asarray(job_b.work))
+        tracker.clear_job("b")
+        # a's job exhausts retries: worker reports the drop and clears it
+        tracker.clear_job("a")
+        tracker.increment(JOBS_DROPPED)
+        assert rt._wave_complete(len(tracker.worker_updates()),
+                                 len(tracker.jobs()))
+
+
 class TestRuntimeRegressions:
     def test_initial_params_reach_workers(self):
         """Workers registering AFTER set_current must pull the seed model
@@ -284,3 +357,53 @@ class TestParameterAveragingTrainer:
         trainer = ParameterAveragingTrainer(net, mesh, local_steps=2)
         trainer.fit(it, epochs=30)
         assert net.score(x, y) < loss0
+
+
+class TestSyncTickRegressions:
+    """The sync master poll must never livelock (stray update with no open
+    wave used to satisfy neither branch and spin until timeout)."""
+
+    def test_stray_update_without_open_wave_is_folded_in(self):
+        it = CollectionJobIterator([np.ones(3)])
+        tracker = InMemoryStateTracker(heartbeat_timeout=1e9)
+        rt = DistributedRuntime(it, None, n_workers=1, tracker=tracker)
+        # a late completion from an already-closed wave
+        tracker.add_worker("late")
+        tracker.add_update("late", 4 * np.ones(3))
+        assert rt._wave_size == 0
+        stop = rt._sync_tick(len(tracker.worker_updates()),
+                             len(tracker.jobs()))
+        assert not stop
+        np.testing.assert_allclose(tracker.get_current(), 4 * np.ones(3))
+        assert not tracker.worker_updates()
+        # next tick proceeds to dispatch the remaining work
+        rt._sync_tick(0, 0)
+        assert rt._wave_size == 1
+
+    def test_undeliverable_orphan_closes_wave_on_survivors(self):
+        """A permanently-dead member must not deadlock the barrier: when no
+        live worker can take its orphan job, the wave closes on the
+        survivors and the orphan leads the next wave."""
+        it = CollectionJobIterator([np.ones(3), 3 * np.ones(3)])
+        tracker = InMemoryStateTracker(heartbeat_timeout=1e9)
+        rt = DistributedRuntime(it, None, n_workers=2, tracker=tracker)
+        tracker.add_worker("a")
+        tracker.add_worker("b")
+        assert rt._open_wave() == 2
+        job_b = tracker.job_for("b")
+        tracker.add_update("b", np.asarray(job_b.work))
+        tracker.clear_job("b")
+        orphan = tracker.remove_worker("a")  # a dies for good
+        rt._orphan_jobs.append(Job(work=orphan.work,
+                                   worker_id=orphan.worker_id))
+        # b holds a pending update -> nobody free; tick must break the
+        # barrier by closing the wave on b's update
+        rt._sync_tick(len(tracker.worker_updates()), len(tracker.jobs()))
+        assert rt._wave_size == 0
+        assert tracker.get_current() is not None
+        assert not tracker.worker_updates()
+        # next tick opens a wave led by the carried orphan job
+        rt._sync_tick(0, 0)
+        assert rt._wave_size == 1
+        job = tracker.job_for("b")
+        np.testing.assert_allclose(job.work, orphan.work)
