@@ -214,11 +214,53 @@ def bench_word2vec():
     return {"value": round(statistics.median(rates), 2), "unit": "pairs/sec"}
 
 
+def bench_flash():
+    """Beyond-parity: the Pallas flash-attention kernel COMPILED on the
+    real chip (not interpret mode), checked against the blockwise
+    reference implementation, then timed. SURVEY §5 long-context."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.attention.blockwise import blockwise_attention
+    from deeplearning4j_tpu.attention.flash_pallas import flash_attention
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    B, H, S, D = 4, 8, 2048, 64
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, H, S, D), dtype=jnp.bfloat16)
+    k = jax.random.normal(kk, (B, H, S, D), dtype=jnp.bfloat16)
+    v = jax.random.normal(kv, (B, H, S, D), dtype=jnp.bfloat16)
+
+    flash = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, interpret=not on_tpu))
+    out = jax.block_until_ready(flash(q, k, v))  # compile + run
+    ref = blockwise_attention(q, k, v, causal=True)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                - ref.astype(jnp.float32))))
+    if err > 0.05:  # bf16 tolerance
+        raise AssertionError(f"flash vs blockwise max err {err}")
+
+    steps = 20
+
+    def run():
+        for _ in range(steps):
+            o = flash(q, k, v)
+        jax.block_until_ready(o)
+
+    elapsed = _median_time(run)
+    return {"value": round(elapsed / steps * 1000, 3), "unit": "ms/step",
+            "lower_is_better": True, "max_err_vs_blockwise": round(err, 4),
+            "compiled_on": jax.devices()[0].platform,
+            "shape": f"{B}x{H}x{S}x{D}"}
+
+
 CONFIGS = {
     "mlp": bench_mlp,
     "lenet": bench_lenet,
     "dbn": bench_dbn,
     "word2vec": bench_word2vec,
+    "flash": bench_flash,
 }
 
 METRIC_NAMES = {
@@ -226,6 +268,7 @@ METRIC_NAMES = {
     "lenet": "lenet_mnist_step_time_ms",
     "dbn": "dbn_pretrain_finetune_samples_per_sec_per_chip",
     "word2vec": "word2vec_skipgram_pairs_per_sec",
+    "flash": "flash_attention_causal_step_time_ms",
 }
 
 

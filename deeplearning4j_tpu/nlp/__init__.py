@@ -24,6 +24,12 @@ from deeplearning4j_tpu.nlp.sentence_iterator import (  # noqa: F401
     LabelAwareSentenceIterator,
     LineSentenceIterator,
 )
+from deeplearning4j_tpu.nlp.documents import (  # noqa: F401
+    DocumentIterator,
+    FileDocumentIterator,
+    InvertedIndex,
+    LabelAwareDocumentIterator,
+)
 from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord  # noqa: F401
 from deeplearning4j_tpu.nlp.huffman import build_huffman  # noqa: F401
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401

@@ -218,3 +218,106 @@ class TestWindows:
         ws = windows(["cat", "zebra"], window_size=3)
         vec = window_as_vector(ws[0], w2v)
         assert vec.shape == (3 * 8,)
+
+
+class TestDocumentIterators:
+    """reference text/documentiterator/ — whole-document iteration with
+    directory labels."""
+
+    def _corpus(self, tmp_path):
+        for label in ("pos", "neg"):
+            d = tmp_path / label
+            d.mkdir()
+            for i in range(2):
+                (d / f"{i}.txt").write_text(f"{label} document {i}")
+        return str(tmp_path)
+
+    def test_file_document_iterator(self, tmp_path):
+        from deeplearning4j_tpu.nlp import FileDocumentIterator
+
+        it = FileDocumentIterator(self._corpus(tmp_path))
+        docs = list(it)
+        assert len(docs) == 4
+        assert any("pos document" in d for d in docs)
+        it.reset()
+        assert it.has_next()
+        assert list(it) == docs  # deterministic order
+
+    def test_label_aware_document_iterator(self, tmp_path):
+        from deeplearning4j_tpu.nlp import LabelAwareDocumentIterator
+
+        it = LabelAwareDocumentIterator(self._corpus(tmp_path))
+        seen = []
+        while it.has_next():
+            doc = it.next_document()
+            seen.append((doc, it.current_label()))
+        assert all(label in doc for doc, label in seen)
+        assert {label for _, label in seen} == {"pos", "neg"}
+
+    def test_rejects_non_directory(self, tmp_path):
+        from deeplearning4j_tpu.nlp import FileDocumentIterator
+
+        with pytest.raises(ValueError):
+            FileDocumentIterator(str(tmp_path / "missing"))
+
+
+class TestInvertedIndex:
+    """reference text/invertedindex/ — word<->doc index + subsampled
+    mini-batches."""
+
+    def _index(self, sample=0.0):
+        from deeplearning4j_tpu.nlp import InvertedIndex
+
+        idx = InvertedIndex(sample=sample, seed=0)
+        idx.add_words_to_doc(0, ["the", "cat", "sat"], label="animals")
+        idx.add_words_to_doc(1, ["the", "dog", "ran"], label="animals")
+        idx.add_words_to_doc(2, ["the", "market", "fell"], label="finance")
+        return idx
+
+    def test_document_round_trip(self):
+        idx = self._index()
+        assert idx.num_documents() == 3
+        assert idx.document(1) == ["the", "dog", "ran"]
+        words, label = idx.document_with_label(2)
+        assert label == "finance"
+        assert idx.document_indices(0).dtype.name == "int32"
+        assert list(idx.all_docs()) == [0, 1, 2]
+
+    def test_postings(self):
+        idx = self._index()
+        assert list(idx.documents("the")) == [0, 1, 2]
+        assert list(idx.documents("dog")) == [1]
+        assert list(idx.documents("unseen")) == []
+        # postings rebuild after more docs arrive
+        idx.add_words_to_doc(3, ["dog", "beats", "market"])
+        assert list(idx.documents("dog")) == [1, 3]
+
+    def test_batch_iter_and_docs(self):
+        idx = self._index()
+        batches = list(idx.batch_iter(2))
+        assert [len(b) for b in batches] == [2, 1]
+        assert sum(len(b) for b in batches) == 3
+
+    def test_mini_batches_no_sampling_keeps_all(self):
+        idx = self._index(sample=0.0)
+        toks = [w for b in idx.mini_batches(4) for w in b]
+        assert len(toks) == 9  # every token survives
+
+    def test_mini_batches_subsampling_drops_frequent(self):
+        from deeplearning4j_tpu.nlp import InvertedIndex
+
+        # threshold = sample * num_docs = 1.0: singletons keep-prob 1.0,
+        # the 400-count word keeps ~5% (reference formula :521-527)
+        idx = InvertedIndex(sample=0.05, seed=0)
+        for d in range(20):
+            idx.add_words_to_doc(d, ["the"] * 20 + [f"rare{d}"])
+        toks = [w for b in idx.mini_batches(64) for w in b]
+        n_the = sum(1 for w in toks if w == "the")
+        n_rare = sum(1 for w in toks if w.startswith("rare"))
+        assert n_rare == 20  # keep-prob clipped to 1.0 for singletons
+        assert n_the < 100  # frequent word heavily subsampled (exp ~21)
+
+    def test_cleanup(self):
+        idx = self._index()
+        idx.cleanup()
+        assert idx.num_documents() == 0
