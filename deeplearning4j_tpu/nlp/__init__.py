@@ -33,6 +33,10 @@ from deeplearning4j_tpu.nlp.documents import (  # noqa: F401
 from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord  # noqa: F401
 from deeplearning4j_tpu.nlp.huffman import build_huffman  # noqa: F401
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
+from deeplearning4j_tpu.nlp.word2vec_iterator import (  # noqa: F401
+    Word2VecDataSetIterator,
+    viterbi_smooth,
+)
 from deeplearning4j_tpu.nlp.glove import CoOccurrences, Glove  # noqa: F401
 from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors  # noqa: F401
 from deeplearning4j_tpu.nlp.serializer import (  # noqa: F401

@@ -8,7 +8,6 @@ matrices ready to feed MultiLayerNetwork.
 
 from __future__ import annotations
 
-import math
 from typing import Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -18,6 +17,7 @@ from deeplearning4j_tpu.nlp.tokenization import (
     TokenizerFactory,
 )
 from deeplearning4j_tpu.nlp.vocab import VocabCache, build_vocab
+from deeplearning4j_tpu.utils import math_utils
 
 
 class BagOfWordsVectorizer:
@@ -53,9 +53,13 @@ class BagOfWordsVectorizer:
 
 
 class TfidfVectorizer(BagOfWordsVectorizer):
-    """tf * log(numDocs / docFreq) weighting (reference TfidfVectorizer)."""
+    """TF-IDF weighting via the MathUtils-parity helpers, matching the
+    reference exactly: `MathUtils.tfidf(tf, idf)` with log10-scaled term
+    frequency and `log10(numDocs / (1 + docFreq))` inverse document
+    frequency (reference TfidfVectorizer.java:63-73 → MathUtils.java)."""
 
     def _weight(self, count: float, word: str) -> float:
-        df = max(1, self.vocab.doc_frequency(word))
-        idf = math.log(max(1, self.vocab.num_docs) / df) if df else 0.0
-        return count * idf
+        return math_utils.tfidf(
+            math_utils.tf(int(count)),
+            math_utils.idf(self.vocab.num_docs,
+                           self.vocab.doc_frequency(word)))

@@ -321,3 +321,111 @@ class TestInvertedIndex:
         idx = self._index()
         idx.cleanup()
         assert idx.num_documents() == 0
+
+
+class TestWord2VecDataSetIterator:
+    """reference Word2VecDataSetIterator: moving-window classification over
+    pretrained vectors, + the Viterbi smoothing the reference pairs it
+    with (core/util/Viterbi.java)."""
+
+    def _fitted_vec(self):
+        from deeplearning4j_tpu.nlp import Word2Vec
+
+        sents = (["the cat sat on the mat"] * 6
+                 + ["stocks fell on the market"] * 6)
+        w2v = Word2Vec(sents, layer_size=16, window=3,
+                       min_word_frequency=1, negative=2, iterations=1,
+                       seed=0)
+        return w2v.fit()
+
+    def _label_iter(self):
+        from deeplearning4j_tpu.nlp import LabelAwareSentenceIterator
+
+        return LabelAwareSentenceIterator([
+            ("animals", "the cat sat on the mat"),
+            ("finance", "stocks fell on the market"),
+            ("animals", "the cat sat"),
+        ])
+
+    def test_shapes_and_labels(self):
+        from deeplearning4j_tpu.nlp import Word2VecDataSetIterator
+
+        vec = self._fitted_vec()
+        it = Word2VecDataSetIterator(vec, self._label_iter(),
+                                     labels=["animals", "finance"], batch=4)
+        assert it.input_columns() == 16 * 3
+        assert it.total_outcomes() == 2
+        total, seen_labels = 0, set()
+        while it.has_next():
+            ds = it.next()
+            assert ds.features.shape[1] == 16 * 3
+            assert ds.labels.shape[1] == 2
+            assert np.all(ds.labels.sum(axis=1) == 1.0)
+            seen_labels |= set(np.argmax(ds.labels, axis=1).tolist())
+            total += ds.num_examples
+        assert total == 6 + 5 + 3  # one window per token
+        assert seen_labels == {0, 1}
+        it.reset()
+        assert it.has_next()
+
+    def test_disk_spill_matches_memory(self):
+        from deeplearning4j_tpu.nlp import Word2VecDataSetIterator
+
+        vec = self._fitted_vec()
+        mem = Word2VecDataSetIterator(vec, self._label_iter(),
+                                      labels=["animals", "finance"],
+                                      batch=64)
+        disk = Word2VecDataSetIterator(vec, self._label_iter(),
+                                       labels=["animals", "finance"],
+                                       batch=64, spill_to_disk=True)
+        a, b = mem.next(), disk.next()
+        np.testing.assert_allclose(a.features, b.features, rtol=1e-6)
+        np.testing.assert_allclose(a.labels, b.labels)
+
+    def test_unknown_label_raises(self):
+        from deeplearning4j_tpu.nlp import Word2VecDataSetIterator
+
+        vec = self._fitted_vec()
+        it = Word2VecDataSetIterator(vec, self._label_iter(),
+                                     labels=["animals"], batch=64)
+        with pytest.raises(ValueError, match="finance"):
+            while it.has_next():
+                it.next()
+
+    def test_end_to_end_classification_with_viterbi(self):
+        """Train an MLP on window vectors, smooth its per-window sentence
+        predictions with Viterbi — the full reference pipeline."""
+        from deeplearning4j_tpu.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nlp import (Word2VecDataSetIterator,
+                                            viterbi_smooth)
+
+        vec = self._fitted_vec()
+        it = Word2VecDataSetIterator(vec, self._label_iter(),
+                                     labels=["animals", "finance"],
+                                     batch=64)
+        ds = it.next()
+        conf = (NeuralNetConfiguration.builder()
+                .lr(0.1).n_in(it.input_columns()).activation_function("tanh")
+                .optimization_algo("iteration_gradient_descent")
+                .num_iterations(60).use_adagrad(False)
+                .list(2).hidden_layer_sizes([16])
+                .override(1, layer="output", loss_function="mcxent",
+                          activation_function="softmax", n_out=2)
+                .pretrain(False).build())
+        net = MultiLayerNetwork(conf)
+        net.fit(ds.features, ds.labels)
+        probs = np.asarray(net.output(ds.features))
+        # corrupt one window's prediction; Viterbi should snap it back
+        noisy = probs.copy()
+        noisy[2] = 1.0 - noisy[2]
+        smoothed = viterbi_smooth(noisy[:6])  # first sentence's 6 windows
+        assert smoothed.shape == (6,)
+        truth = np.argmax(ds.labels[:6], axis=1)
+        assert (smoothed == truth).mean() >= 5 / 6
+
+    def test_viterbi_smooth_validates_shape(self):
+        from deeplearning4j_tpu.nlp import viterbi_smooth
+
+        with pytest.raises(ValueError):
+            viterbi_smooth(np.ones(5))
