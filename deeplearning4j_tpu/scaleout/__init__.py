@@ -28,6 +28,8 @@ from deeplearning4j_tpu.scaleout.api import (  # noqa: F401
     JobAggregator,
     JobIterator,
     LocalFileUpdateSaver,
+    LocalWorkRetriever,
+    WorkRetriever,
     InMemoryUpdateSaver,
     WorkerPerformer,
     WorkRouter,

@@ -275,5 +275,75 @@ class LocalFileUpdateSaver(UpdateSaver):
     def clear(self):
         with self._lock:
             for f in os.listdir(self.dir):
-                if f.endswith(".update.pkl"):
+                if f.endswith(".update.npy"):
                     os.unlink(os.path.join(self.dir, f))
+
+
+class WorkRetriever:
+    """Per-worker dataset storage/retrieval — keeps job payloads OUT of
+    the coordination plane so the tracker/RPC path carries only light
+    job descriptors (reference WorkRetriever.java:33-62: save/load/clear/
+    workers)."""
+
+    def save(self, worker_id: str, job: "Job") -> None:
+        raise NotImplementedError
+
+    def load(self, worker_id: str) -> Optional["Job"]:
+        raise NotImplementedError
+
+    def clear(self, worker_id: str) -> None:
+        raise NotImplementedError
+
+    def workers(self) -> List[str]:
+        raise NotImplementedError
+
+
+class LocalWorkRetriever(WorkRetriever):
+    """File-per-worker work store (reference LocalWorkRetriever.java) on
+    any shared filesystem, using the no-pickle npz+JSON checkpoint codec
+    so a shared work directory cannot execute code on read."""
+
+    SUFFIX = ".work.bin"
+
+    def __init__(self, directory: Optional[str] = None):
+        self.dir = directory or tempfile.mkdtemp(prefix="dl4j_tpu_work_")
+        os.makedirs(self.dir, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, worker_id: str) -> str:
+        return os.path.join(self.dir,
+                            worker_id.replace(os.sep, "_") + self.SUFFIX)
+
+    def save(self, worker_id, job):
+        # late imports: rpc/checkpoint depend on api's Job
+        from deeplearning4j_tpu.scaleout.checkpoint import dump_payload
+        from deeplearning4j_tpu.scaleout.rpc import _to_wire
+
+        data = dump_payload(_to_wire(job))
+        with self._lock:
+            tmp = self._path(worker_id) + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, self._path(worker_id))
+
+    def load(self, worker_id):
+        from deeplearning4j_tpu.scaleout.checkpoint import load_payload
+        from deeplearning4j_tpu.scaleout.rpc import _from_wire
+
+        path = self._path(worker_id)
+        with self._lock:
+            if not os.path.exists(path):
+                return None
+            with open(path, "rb") as f:
+                return _from_wire(load_payload(f.read()))
+
+    def clear(self, worker_id):
+        with self._lock:
+            path = self._path(worker_id)
+            if os.path.exists(path):
+                os.unlink(path)
+
+    def workers(self):
+        with self._lock:
+            return [f[:-len(self.SUFFIX)] for f in os.listdir(self.dir)
+                    if f.endswith(self.SUFFIX)]

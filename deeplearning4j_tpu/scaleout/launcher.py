@@ -42,6 +42,7 @@ log = logging.getLogger(__name__)
 PERFORMER_CLASS = "performer_class"
 PERFORMER_CONF = "performer_conf"
 TRACKER_ADDRESS = "tracker_address"
+WORK_DIR = "work_dir"  # shared WorkRetriever directory (optional)
 
 
 def _resolve_performer(class_path: str):
@@ -69,7 +70,11 @@ class MultiProcessMaster(DistributedRuntime):
                  n_workers: int = 2,
                  host: str = "127.0.0.1", port: int = 0,
                  conf_json: Optional[str] = None,
+                 work_dir: Optional[str] = None,
                  **kw):
+        if work_dir is not None:
+            from deeplearning4j_tpu.scaleout.api import LocalWorkRetriever
+            kw.setdefault("work_retriever", LocalWorkRetriever(work_dir))
         super().__init__(job_iterator, performer_factory=None,
                          n_workers=n_workers, **kw)
         self.conf_json = conf_json
@@ -77,12 +82,15 @@ class MultiProcessMaster(DistributedRuntime):
         self.registry = registry
         self.server = StateTrackerServer(self.tracker, host=host, port=port)
         self.server.start()
-        registry.register_run(run_name, {
+        run_conf = {
             TRACKER_ADDRESS: self.server.address,
             PERFORMER_CLASS: performer_class,
             PERFORMER_CONF: performer_conf or {},
             "n_workers": n_workers,
-        })
+        }
+        if work_dir is not None:
+            run_conf[WORK_DIR] = work_dir
+        registry.register_run(run_name, run_conf)
 
     def start_workers(self):  # workers are separate processes
         pass
@@ -107,8 +115,13 @@ def run_worker(*, registry_root: str, run_name: str, worker_id: str,
     performer = performer_cls()
     if conf.get(PERFORMER_CONF):
         performer.setup(conf[PERFORMER_CONF])
+    retriever = None
+    if conf.get(WORK_DIR):
+        from deeplearning4j_tpu.scaleout.api import LocalWorkRetriever
+        retriever = LocalWorkRetriever(conf[WORK_DIR])
     worker = _Worker(worker_id, tracker, performer,
-                     interval=heartbeat_interval)
+                     interval=heartbeat_interval,
+                     work_retriever=retriever)
     log.info("worker %s joined run %s at %s", worker_id, run_name,
              conf[TRACKER_ADDRESS])
     try:

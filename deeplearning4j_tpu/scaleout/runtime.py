@@ -53,12 +53,14 @@ class _Worker(threading.Thread):
     MAX_RETRIES = 3
 
     def __init__(self, worker_id: str, tracker: InMemoryStateTracker,
-                 performer: WorkerPerformer, interval: float):
+                 performer: WorkerPerformer, interval: float,
+                 work_retriever=None):
         super().__init__(name=f"dl4j-worker-{worker_id}", daemon=True)
         self.worker_id = worker_id
         self.tracker = tracker
         self.performer = performer
         self.interval = interval
+        self.work_retriever = work_retriever
         self.performed = 0
         self.paused = threading.Event()  # set => skip heartbeats (fault inj.)
 
@@ -80,10 +82,18 @@ class _Worker(threading.Thread):
             job = tracker.job_for(wid)
             if job is not None and job.result is None:
                 try:
+                    if job.work is None and self.work_retriever is not None:
+                        # payload travels via the WorkRetriever data plane,
+                        # not the tracker (reference WorkRetriever.load)
+                        stored = self.work_retriever.load(wid)
+                        if stored is not None:
+                            job.work = stored.work
                     self.performer.perform(job)
                     tracker.add_update(wid, job.result)
                     self.performed += 1
                     tracker.clear_job(wid)
+                    if self.work_retriever is not None:
+                        self.work_retriever.clear(wid)
                 except Exception:  # requeue (bounded), don't kill the loop
                     log.exception("worker %s failed job", wid)
                     tracker.clear_job(wid)
@@ -122,6 +132,7 @@ class DistributedRuntime:
         save_every_waves: int = 0,
         initial_params: Optional[np.ndarray] = None,
         aggregator_factory: Optional[Callable] = None,
+        work_retriever=None,
     ):
         self.job_iterator = job_iterator
         self.tracker = tracker or InMemoryStateTracker()
@@ -139,6 +150,7 @@ class DistributedRuntime:
         self.model_saver = model_saver
         self.save_every_waves = save_every_waves
         self.workers: List[_Worker] = []
+        self.work_retriever = work_retriever
         self.aggregator_factory = (aggregator_factory
                                    or ParameterAveragingAggregator)
         self.waves = 0
@@ -156,7 +168,8 @@ class DistributedRuntime:
     # ------------------------------------------------------------ lifecycle
     def start_workers(self):
         for i, performer in enumerate(self.performers):
-            w = _Worker(f"worker-{i}", self.tracker, performer, self.interval)
+            w = _Worker(f"worker-{i}", self.tracker, performer, self.interval,
+                        work_retriever=self.work_retriever)
             self.workers.append(w)
             w.start()
 
@@ -183,6 +196,12 @@ class DistributedRuntime:
                     break
             else:
                 break
+            if self.work_retriever is not None and job.work is not None:
+                # data plane: payload goes through the WorkRetriever
+                # (reference BatchActor routeJob -> workRetriever.save);
+                # the tracker carries only the light descriptor
+                self.work_retriever.save(wid, job)
+                job = Job(work=None, worker_id=wid, retries=job.retries)
             self.router.route_job(job)
             sent += 1
         return sent
@@ -304,10 +323,19 @@ class DistributedRuntime:
             log.warning("evicting stale worker %s", wid)
             orphan = self.tracker.remove_worker(wid)
             if orphan is not None and orphan.result is None:
+                work = orphan.work
+                if work is None and self.work_retriever is not None:
+                    # payload lives in the WorkRetriever under the evicted
+                    # worker's id; pull it back so the re-dispatch can
+                    # re-save it under the new assignee
+                    stored = self.work_retriever.load(wid)
+                    if stored is not None:
+                        work = stored.work
+                    self.work_retriever.clear(wid)
                 # fresh Job: the evicted worker may still be mutating the
                 # old instance; sharing it would let a late completion
                 # poison the reassigned copy
-                self._orphan_jobs.append(Job(work=orphan.work,
+                self._orphan_jobs.append(Job(work=work,
                                              worker_id=orphan.worker_id,
                                              retries=orphan.retries))
 

@@ -407,3 +407,52 @@ class TestSyncTickRegressions:
         assert rt._wave_size == 1
         job = tracker.job_for("b")
         np.testing.assert_allclose(job.work, orphan.work)
+
+
+class TestWorkRetriever:
+    """reference WorkRetriever.java / LocalWorkRetriever.java — per-worker
+    dataset storage so payloads bypass the coordination plane."""
+
+    def test_save_load_clear_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.scaleout import Job, LocalWorkRetriever
+
+        wr = LocalWorkRetriever(str(tmp_path))
+        ds = DataSet(np.random.rand(4, 3).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[[0, 1, 0, 1]])
+        wr.save("w0", Job(work=ds, worker_id="w0"))
+        assert wr.workers() == ["w0"]
+        loaded = wr.load("w0")
+        np.testing.assert_allclose(loaded.work.features, ds.features)
+        np.testing.assert_allclose(loaded.work.labels, ds.labels)
+        wr.clear("w0")
+        assert wr.load("w0") is None
+        assert wr.workers() == []
+
+    def test_runtime_routes_payloads_through_retriever(self, tmp_path):
+        """With a WorkRetriever configured, the tracker only ever carries
+        payload-free descriptors; training still converges."""
+        from deeplearning4j_tpu.scaleout import LocalWorkRetriever
+
+        conf_json = iris_conf_json(iters=2)
+        seed_net = MultiLayerNetwork.from_config_json(conf_json)
+        it = CollectionJobIterator(iris_batches(6, batch_size=16))
+        wr = LocalWorkRetriever(str(tmp_path))
+        tracker = InMemoryStateTracker()
+
+        routed_payloads = []
+        orig_add_job = tracker.add_job
+
+        def spy_add_job(job):
+            routed_payloads.append(job.work)
+            return orig_add_job(job)
+
+        tracker.add_job = spy_add_job
+        rt = DistributedRuntime(
+            it, lambda: NeuralNetWorkPerformer(conf_json, epochs=1),
+            n_workers=2, tracker=tracker, work_retriever=wr,
+            initial_params=np.asarray(seed_net.params()))
+        final = rt.run(timeout=120)
+        assert final is not None
+        assert routed_payloads  # jobs flowed
+        assert all(w is None for w in routed_payloads)  # tracker stayed light
+        assert wr.workers() == []  # payloads cleaned up after perform
