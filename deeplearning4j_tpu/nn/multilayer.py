@@ -29,6 +29,7 @@ from deeplearning4j_tpu.nn.api import merge_params
 from deeplearning4j_tpu.nn.layers import make_layer
 from deeplearning4j_tpu.optimize.solver import Solver
 from deeplearning4j_tpu.optimize.updater import NetworkGradientUpdater
+from deeplearning4j_tpu.utils.sanitize import validate_batch
 
 log = logging.getLogger(__name__)
 
@@ -217,6 +218,9 @@ class MultiLayerNetwork:
                                          jnp.asarray(ds.labels))
             return
         x, labels = jnp.asarray(x), jnp.asarray(labels)
+        validate_batch(x, labels, n_in=self.layers[0].conf.n_in
+                       if not self.conf.input_preprocessors.get(0) else None,
+                       n_out=self.layers[-1].conf.n_out, context="fit")
         if self.conf.pretrain and self.has_pretrain_layers():
             self.pretrain(x)
         for _ in range(epochs):
@@ -326,7 +330,11 @@ class MultiLayerNetwork:
 
     # ----------------------------------------------------------- inference
     def feed_forward(self, x) -> List[jnp.ndarray]:
-        return self.feed_forward_fn(self._params, jnp.asarray(x))
+        x = jnp.asarray(x)
+        validate_batch(x, n_in=self.layers[0].conf.n_in
+                       if not self.conf.input_preprocessors.get(0) else None,
+                       context="feed_forward")
+        return self.feed_forward_fn(self._params, x)
 
     def output(self, x) -> jnp.ndarray:
         """Output-layer activations (reference output :1197)."""

@@ -1,0 +1,95 @@
+"""Numerical sanitizers + input validation.
+
+Parity: the reference's scattered numerical guards —
+`LinAlgExceptions.assertValidNum` on backprop deltas
+(core/nn/multilayer/MultiLayerNetwork.java:550,:572), the NaN scrub
+`BooleanIndexing.applyWhere(output, isNan, EPS)`
+(core/nn/layers/OutputLayer.java:75,:89), and the shape asserts
+throughout (e.g. MultiLayerNetwork.java:889) — promoted into one module
+(SURVEY §5 names this the TPU build's "shape/dtype validation layer").
+
+TPU-native design: `scrub_nan` is a jittable jnp op that fuses into the
+surrounding XLA program; `assert_valid_num` is a HOST-side check for
+eager/debug paths (calling it on a traced value would force a sync —
+inside jit use `debug_nans()` instead, which turns on XLA's nan-checking
+mode); shape validation happens before trace time so errors carry layer
+context instead of a dot_general shape dump.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+EPS = 1e-6
+
+__all__ = ["EPS", "assert_valid_num", "scrub_nan", "debug_nans",
+           "validate_batch"]
+
+
+def assert_valid_num(arr, name: str = "array") -> None:
+    """Raise ValueError if `arr` contains NaN/Inf (reference
+    LinAlgExceptions.assertValidNum). Host-side: forces the value, so use
+    only on eager/debug paths, not inside jit."""
+    a = np.asarray(arr)
+    if not np.all(np.isfinite(a)):
+        n_nan = int(np.isnan(a).sum())
+        n_inf = int(np.isinf(a).sum())
+        raise ValueError(
+            f"{name} contains non-finite values ({n_nan} NaN, {n_inf} Inf "
+            f"of {a.size})")
+
+
+def scrub_nan(x: jnp.ndarray, eps: float = EPS) -> jnp.ndarray:
+    """Replace NaN with `eps` (reference OutputLayer.java:75,:89 NaN
+    scrub). Jittable; fuses into the surrounding program."""
+    return jnp.where(jnp.isnan(x), jnp.asarray(eps, dtype=x.dtype), x)
+
+
+@contextlib.contextmanager
+def debug_nans(enable: bool = True):
+    """Toggle jax_debug_nans for a scope: every jitted computation
+    re-checks outputs for NaN and re-runs un-jitted to pinpoint the
+    primitive that produced it. The in-jit equivalent of the reference's
+    assertValidNum-on-every-delta, at real debug cost — wrap only the
+    step you are hunting."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", bool(enable))
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def validate_batch(x, labels=None, *, n_in: Optional[int] = None,
+                   n_out: Optional[int] = None,
+                   context: str = "fit") -> None:
+    """Pre-trace shape validation with layer context (reference shape
+    asserts, MultiLayerNetwork.java:889). Raises ValueError before XLA
+    ever sees the arrays, so the message names the config field instead
+    of a dot_general contraction mismatch."""
+    if x.ndim < 2:
+        raise ValueError(
+            f"{context}: features must be at least 2-D (batch, features), "
+            f"got shape {tuple(x.shape)}")
+    if n_in and x.shape[-1] != n_in:
+        raise ValueError(
+            f"{context}: features have {x.shape[-1]} columns but the "
+            f"first layer's n_in is {n_in}")
+    if labels is not None:
+        if labels.ndim != 2:
+            raise ValueError(
+                f"{context}: labels must be 2-D one-hot (batch, classes), "
+                f"got shape {tuple(labels.shape)}")
+        if labels.shape[0] != x.shape[0]:
+            raise ValueError(
+                f"{context}: {x.shape[0]} examples but "
+                f"{labels.shape[0]} label rows")
+        if n_out and labels.shape[-1] != n_out:
+            raise ValueError(
+                f"{context}: labels have {labels.shape[-1]} columns but "
+                f"the output layer's n_out is {n_out}")

@@ -213,3 +213,70 @@ class TestImageLoaderAndArchive:
         with pytest.raises(tarfile.FilterError):
             unzip_file_to(t, str(tmp_path / "out3"))
         assert not (outside / "evil.txt").exists()
+
+
+class TestSanitize:
+    """reference numerical guards: assertValidNum / NaN scrub / shape
+    asserts (SURVEY §5 sanitizers)."""
+
+    def test_assert_valid_num(self):
+        from deeplearning4j_tpu.utils.sanitize import assert_valid_num
+
+        assert_valid_num(np.ones(3), "ok")
+        with pytest.raises(ValueError, match="2 NaN, 1 Inf"):
+            assert_valid_num(np.array([1.0, np.nan, np.nan, np.inf]), "bad")
+
+    def test_scrub_nan_is_jittable(self):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.utils.sanitize import scrub_nan
+
+        x = jnp.array([1.0, jnp.nan, 3.0])
+        out = jax.jit(scrub_nan)(x)
+        np.testing.assert_allclose(np.asarray(out), [1.0, 1e-6, 3.0])
+
+    def test_debug_nans_context(self):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.utils.sanitize import debug_nans
+
+        prev = jax.config.jax_debug_nans
+        with debug_nans():
+            assert jax.config.jax_debug_nans
+            with pytest.raises(FloatingPointError):
+                jax.jit(lambda x: jnp.log(x))(jnp.array(-1.0)).block_until_ready()
+        assert jax.config.jax_debug_nans == prev
+
+    def test_validate_batch_messages(self):
+        from deeplearning4j_tpu.utils.sanitize import validate_batch
+
+        x = np.ones((4, 5), np.float32)
+        y = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+        validate_batch(x, y, n_in=5, n_out=3)
+        with pytest.raises(ValueError, match="n_in is 4"):
+            validate_batch(x, y, n_in=4)
+        with pytest.raises(ValueError, match="n_out is 2"):
+            validate_batch(x, y, n_in=5, n_out=2)
+        with pytest.raises(ValueError, match="label rows"):
+            validate_batch(x, y[:3], n_in=5, n_out=3)
+        with pytest.raises(ValueError, match="at least 2-D"):
+            validate_batch(np.ones(4))
+
+    def test_multilayer_rejects_bad_width_with_clear_error(self):
+        from deeplearning4j_tpu.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (NeuralNetConfiguration.builder()
+                .lr(0.1).n_in(4).activation_function("tanh")
+                .optimization_algo("iteration_gradient_descent")
+                .num_iterations(1)
+                .list(2).hidden_layer_sizes([8])
+                .override(1, layer="output", loss_function="mcxent",
+                          activation_function="softmax", n_out=3)
+                .pretrain(False).build())
+        net = MultiLayerNetwork(conf)
+        bad = np.ones((2, 5), np.float32)
+        with pytest.raises(ValueError, match="n_in is 4"):
+            net.output(bad)
+        with pytest.raises(ValueError, match="n_in is 4"):
+            net.fit(bad, np.eye(3, dtype=np.float32)[[0, 1]])
