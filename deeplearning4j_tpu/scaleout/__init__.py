@@ -44,3 +44,15 @@ from deeplearning4j_tpu.scaleout.checkpoint import (  # noqa: F401
     DefaultModelSaver,
     load_checkpoint,
 )
+from deeplearning4j_tpu.scaleout.checkpoint import UriModelSaver  # noqa: F401
+from deeplearning4j_tpu.scaleout.registry import ConfigRegistry  # noqa: F401
+from deeplearning4j_tpu.scaleout.storage import (  # noqa: F401
+    ArtifactStore,
+    StorageDataSetIterator,
+)
+from deeplearning4j_tpu.scaleout.provision import (  # noqa: F401
+    ClusterSetup,
+    HostProvisioner,
+    LocalTransport,
+    SshTransport,
+)

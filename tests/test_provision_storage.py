@@ -1,0 +1,156 @@
+"""Cluster-infrastructure tier tests: provisioning transports + the
+URI-addressed artifact plane (reference deeplearning4j-aws HostProvisioner/
+ClusterSetup + S3Downloader/Uploader/BucketIterator/BaseS3DataSetIterator)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.scaleout import (
+    ArtifactStore,
+    ClusterSetup,
+    HostProvisioner,
+    LocalTransport,
+    StorageDataSetIterator,
+)
+
+
+class TestArtifactStore:
+    def _store(self, tmp_path):
+        return ArtifactStore(str(tmp_path / "bucket"))
+
+    def test_put_get_round_trip(self, tmp_path):
+        store = self._store(tmp_path)
+        store.put_bytes("run1/model.bin", b"\x00\x01payload")
+        assert store.get_bytes("run1/model.bin") == b"\x00\x01payload"
+        assert store.exists("run1/model.bin")
+        store.delete("run1/model.bin")
+        assert not store.exists("run1/model.bin")
+
+    def test_listing_sorted_and_skips_tmp(self, tmp_path):
+        store = self._store(tmp_path)
+        store.put_bytes("b/2.bin", b"2")
+        store.put_bytes("a/1.bin", b"1")
+        with open(os.path.join(store.root, "junk.tmp"), "wb") as f:
+            f.write(b"inflight")
+        assert store.keys() == [os.path.join("a", "1.bin"),
+                                os.path.join("b", "2.bin")]
+        assert list(store) == store.keys()
+        assert store.keys("a") == [os.path.join("a", "1.bin")]
+
+    def test_key_escape_rejected(self, tmp_path):
+        store = self._store(tmp_path)
+        with pytest.raises(ValueError, match="escapes"):
+            store.put_bytes("../../etc/evil", b"x")
+
+    def test_file_upload_download(self, tmp_path):
+        store = self._store(tmp_path)
+        src = tmp_path / "local.bin"
+        src.write_bytes(b"abc")
+        store.upload_file(str(src), "stage/local.bin")
+        dest = tmp_path / "out" / "local.bin"
+        store.download_file("stage/local.bin", str(dest))
+        assert dest.read_bytes() == b"abc"
+
+    def test_gs_scheme_resolves_via_mount(self, tmp_path):
+        mount = tmp_path / "gcs-mount"
+        store = ArtifactStore("gs://bucket/run",
+                              mounts={"gs": str(mount)})
+        store.put_bytes("ckpt.bin", b"x")
+        assert (mount / "bucket" / "run" / "ckpt.bin").read_bytes() == b"x"
+
+    def test_gs_scheme_without_mount_errors(self):
+        env = os.environ.pop("DL4J_TPU_ARTIFACT_ROOT", None)
+        try:
+            with pytest.raises(ValueError, match="mount"):
+                ArtifactStore("gs://bucket/run")
+        finally:
+            if env is not None:
+                os.environ["DL4J_TPU_ARTIFACT_ROOT"] = env
+
+
+class TestStorageDataSetIterator:
+    def test_streams_datasets_in_key_order(self, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        for i in range(3):
+            ds = DataSet(np.full((4, 2), i, np.float32),
+                         np.eye(2, dtype=np.float32)[[0, 1, 0, 1]])
+            store.put_dataset(f"train/part-{i}.bin", ds)
+        it = StorageDataSetIterator(store, "train")
+        assert it.input_columns() == 2
+        assert it.total_outcomes() == 2
+        vals = []
+        while it.has_next():
+            vals.append(float(it.next().features[0, 0]))
+        assert vals == [0.0, 1.0, 2.0]
+        it.reset()
+        assert it.has_next()
+
+    def test_empty_prefix_errors(self, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        with pytest.raises(ValueError, match="no datasets"):
+            StorageDataSetIterator(store, "nothing")
+
+
+class TestProvisioning:
+    def test_host_provisioner_upload_and_run_local(self, tmp_path):
+        script = tmp_path / "setup.sh"
+        script.write_text("echo provisioned-$1 > %s/marker.txt\n" % tmp_path)
+        prov = HostProvisioner(LocalTransport())
+        cwd = os.getcwd()
+        os.chdir(tmp_path)
+        try:
+            rc, out = prov.upload_and_run(str(script))
+        finally:
+            os.chdir(cwd)
+        assert rc == 0
+        assert (tmp_path / "marker.txt").read_text().startswith("provisioned")
+
+    def test_run_remote_command(self):
+        prov = HostProvisioner(LocalTransport())
+        rc, out = prov.run_remote_command(
+            [sys.executable, "-c", "print(6*7)"])
+        assert rc == 0
+        assert "42" in out
+
+    def test_cluster_setup_fans_out(self, tmp_path):
+        """Provision 2 'hosts' (local transports) — each runs the worker
+        command; with a stub python that records its argv we verify the
+        launcher invocation without a live master."""
+        record = tmp_path / "calls"
+        record.mkdir()
+        stub = tmp_path / "stub.py"
+        stub.write_text(
+            "import sys, os, uuid\n"
+            "open(os.path.join(%r, uuid.uuid4().hex), 'w')"
+            ".write(' '.join(sys.argv[1:]))\n" % str(record))
+        # python=interpreter + stub-as-module trick: run stub directly
+        cs = ClusterSetup({"w0": LocalTransport(), "w1": LocalTransport()},
+                          registry_root=str(tmp_path / "reg"),
+                          run_name="demo", python=sys.executable)
+        # swap the worker command to drive the stub instead of the real
+        # launcher (which would block waiting for a master)
+        cs._worker_command = lambda wid: [
+            sys.executable, str(stub), "worker", "--registry",
+            cs.registry_root, "--run", cs.run_name, "--worker-id", wid]
+        results = cs.provision_workers(detach=False)
+        assert set(results) == {"w0", "w1"}
+        assert all(rc == 0 for rc, _ in results.values())
+        recorded = [f.read_text() for f in record.iterdir()]
+        assert len(recorded) == 2
+        assert any("--worker-id w0" in r for r in recorded)
+        assert any("--worker-id w1" in r for r in recorded)
+
+    def test_setup_script_failure_isolated_per_host(self, tmp_path):
+        bad = tmp_path / "bad.sh"
+        bad.write_text("exit 3\n")
+        cs = ClusterSetup({"w0": LocalTransport()},
+                          registry_root="unused", run_name="demo",
+                          setup_script=str(bad))
+        results = cs.provision_workers(detach=False)
+        rc, out = results["w0"]
+        assert rc == -1
+        assert "setup script failed" in out
