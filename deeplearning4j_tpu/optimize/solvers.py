@@ -133,7 +133,11 @@ class IterationGradientDescent(BaseOptimizer):
         @jax.jit
         def step(x, state, key, *data):
             score, g = jax.value_and_grad(self.loss)(x, key, *data)
-            updates, state = updater.update(g, state, x)
+            # data[0] (when present) is the mini-batch: its leading dim is
+            # the reference's ÷batchSize denominator (adagrad branch)
+            bs = data[0].shape[0] if data and hasattr(data[0], "shape") \
+                and getattr(data[0], "ndim", 0) >= 1 else 1
+            updates, state = updater.update(g, state, x, bs)
             return x - sign * updates, state, score, jnp.linalg.norm(g)
 
         return step

@@ -4,13 +4,18 @@ Parity: reference core/optimize/GradientAdjustment.updateGradientAccordingToPara
 (GradientAdjustment.java:66-113): AdaGrad-or-plain-lr scaling, momentum with an
 iteration-indexed schedule, optional unit-norm constraint.
 
-Two deliberate deltas: (a) the reference divides the raw gradient by the batch
-size because its losses are sums; our losses (ops.losses) are already
-per-example means, so no second division happens by default
-(`divide_by_batch=False`); (b) the reference's L2 term lives in the LOSS here
-(MultiLayerNetwork.loss_fn / pretrain losses), not in the updater, so every
-solver path — including the loss-only line-search family — sees the same
-regularized objective exactly once.
+Two deliberate deltas: (a) the reference divides the final update by the
+batch size because its losses are sums; our losses (ops.losses) are
+per-example means, which makes that division a no-op-equivalent on the
+plain-lr branch (sum/batch == mean) — but NOT on the AdaGrad branch:
+AdaGrad normalizes the gradient by its own accumulated scale, so sum-vs-mean
+cancels and the reference's ÷batchSize is a REAL 1/B step-size factor that
+must be reproduced (without it, batch-512 training takes 512× the
+reference's step and diverges). Callers therefore pass `batch_size` into
+`update()` on the adagrad path; (b) the reference's L2 term lives in the
+LOSS here (MultiLayerNetwork.loss_fn / pretrain losses), not in the
+updater, so every solver path — including the loss-only line-search
+family — sees the same regularized objective exactly once.
 
 Implemented as a pure (state, grads) -> (updates, state) transform over
 pytrees so it jits and shards; state is {hist, velocity} mirroring ND4J's
@@ -41,9 +46,13 @@ class GradientUpdater:
         self.divide_by_batch = divide_by_batch
 
     def init(self, params) -> UpdaterState:
-        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
-        return UpdaterState(hist=zeros, velocity=zeros,
-                            iteration=jnp.zeros((), jnp.int32))
+        # hist and velocity must be DISTINCT buffers: the train step
+        # donates the state tree, and XLA rejects donating one buffer
+        # through two aliasing leaves
+        return UpdaterState(
+            hist=jax.tree_util.tree_map(jnp.zeros_like, params),
+            velocity=jax.tree_util.tree_map(jnp.zeros_like, params),
+            iteration=jnp.zeros((), jnp.int32))
 
     def _momentum_at(self, iteration):
         """Piecewise-constant momentum schedule (GradientAdjustment.java:79)."""
@@ -79,7 +88,10 @@ class GradientUpdater:
             norm = jnp.linalg.norm(flat) + 1e-12
             updates = jax.tree_util.tree_map(lambda u: u / norm, updates)
 
-        if self.divide_by_batch and batch_size > 1:
+        # reference GradientAdjustment ends with gradient.divi(batchSize);
+        # with mean losses that only changes the adagrad branch (see module
+        # docstring) — divide there, or wherever explicitly requested
+        if (c.use_adagrad or self.divide_by_batch) and batch_size > 1:
             updates = jax.tree_util.tree_map(
                 lambda u: u / batch_size, updates)
 

@@ -54,7 +54,8 @@ class DataParallelTrainer:
         def step(params, upd_state, x, labels, rng):
             score, grads = jax.value_and_grad(net.loss_fn)(
                 params, x, labels, rng=rng, training=True)
-            updates, upd_state = updater.update(grads, upd_state, params)
+            updates, upd_state = updater.update(grads, upd_state, params,
+                                                x.shape[0])
             params = jax.tree_util.tree_map(lambda p, u: p - u, params, updates)
             return params, upd_state, score
 

@@ -1,0 +1,26 @@
+"""DBN: layer-wise RBM pretraining + supervised finetune (reference
+MultiLayerNetwork.pretrain + finetune over CD-1 RBMs)."""
+import numpy as np
+
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.mnist import synthetic_mnist
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+conf = (NeuralNetConfiguration.builder()
+        .lr(0.05).n_in(784).activation_function("sigmoid")
+        .optimization_algo("iteration_gradient_descent")
+        .num_iterations(10).batch_size(512)
+        .list(3).hidden_layer_sizes([256, 128])
+        .override(0, layer="rbm", k=1)
+        .override(1, layer="rbm", k=1)
+        .override(2, layer="output", loss_function="mcxent",
+                  activation_function="softmax", n_out=10)
+        .pretrain(True)  # unsupervised CD-1 pass before finetune
+        .build())
+
+net = MultiLayerNetwork(conf)
+x, y = synthetic_mnist(4096)
+before = net.score(x, y)
+net.fit(x, y)
+print(f"score: {before:.4f} -> {net.score(x, y):.4f}")
+print("accuracy:", float((net.predict(x) == np.argmax(np.asarray(y), 1)).mean()))

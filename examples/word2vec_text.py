@@ -1,0 +1,19 @@
+"""Word2Vec skip-gram + nearest neighbors + the moving-window
+classification bridge (reference Word2Vec + Word2VecDataSetIterator)."""
+from deeplearning4j_tpu.nlp import (LabelAwareSentenceIterator, Word2Vec,
+                                    Word2VecDataSetIterator)
+
+corpus = ["the cat sat on the mat", "the dog sat on the rug",
+          "the king wears the crown", "the queen wears the crown"] * 50
+
+w2v = Word2Vec(corpus, layer_size=64, window=3, min_word_frequency=2,
+               negative=5, iterations=20, seed=7).fit()
+print("nearest to 'king':", w2v.words_nearest("king", n=3))
+
+it = Word2VecDataSetIterator(
+    w2v,
+    LabelAwareSentenceIterator([("animals", "the cat sat on the mat"),
+                                ("royalty", "the king wears the crown")]),
+    labels=["animals", "royalty"], batch=16)
+ds = it.next()
+print("window batch:", ds.features.shape, "->", ds.labels.shape)
