@@ -66,14 +66,14 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
         v_next = lax.ppermute(v_cur, axis_name, perm)
         return (acc_new, m_new, s_new, k_next, v_next), None
 
-    # constant-initialized carries must be marked device-varying for the
-    # scan inside shard_map (jax vma rules)
-    def varying(a):
-        return lax.pcast(a, (axis_name,), to="varying")
-
-    acc0 = varying(jnp.zeros(q32.shape, jnp.float32))
-    m0 = varying(jnp.full(q32.shape[:-1], NEG_INF, jnp.float32))
-    s0 = varying(jnp.zeros(q32.shape[:-1], jnp.float32))
+    # constant-initialized carries must carry the same device-varying axes
+    # as the scanned k/v (jax vma rules). Deriving them from q32 inherits
+    # the right axis set whatever the in_specs shard over (sp alone, or
+    # dp x sp when batch_axis is set); XLA folds the dummy arithmetic.
+    acc0 = q32 * 0.0
+    row = jnp.sum(q32, axis=-1) * 0.0
+    m0 = row + NEG_INF
+    s0 = row
     (acc, m, s, _, _), _ = lax.scan(
         fold, (acc0, m0, s0, k, v), jnp.arange(n_dev))
     out = acc / jnp.maximum(s, 1e-30)[..., None]
@@ -81,25 +81,33 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
-                   causal: bool = False):
+                   causal: bool = False, batch_axis: Optional[str] = None):
     """Full attention with Q/K/V sequence-sharded over `axis`.
 
     q, k, v: (batch, T, d) global arrays (T divisible by the axis size).
     Returns (batch, T, d), sequence-sharded the same way. Each ring step
     processes one visiting shard in a single einsum (per-device shards
     are already block-sized — the ring IS the blocking).
+
+    `batch_axis` additionally shards the batch dimension over a second
+    mesh axis — the dp×sp composition (each data-parallel replica group
+    runs its own ring over the `axis` dimension of the mesh).
     """
     n_dev = mesh.shape[axis]
     t = q.shape[-2]
     if t % n_dev:
         raise ValueError(f"sequence length {t} not divisible by mesh "
                          f"axis {axis!r} size {n_dev}")
+    if batch_axis is not None and q.shape[0] % mesh.shape[batch_axis]:
+        raise ValueError(f"batch {q.shape[0]} not divisible by mesh "
+                         f"axis {batch_axis!r} size {mesh.shape[batch_axis]}")
 
+    spec = P(batch_axis, axis, None)
     fn = _shard_map(
         partial(_ring_attention_local, axis_name=axis, causal=causal),
         mesh=mesh,
-        in_specs=(P(None, axis, None),) * 3,
-        out_specs=P(None, axis, None),
+        in_specs=(spec,) * 3,
+        out_specs=spec,
     )
     with mesh:
         return fn(q, k, v)

@@ -227,3 +227,24 @@ class TestSelfAttentionLayer:
         params = layer.init_params(jax.random.PRNGKey(0))
         with pytest.raises(ValueError):
             layer.activate(params, jnp.ones((4, 8)))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_dp_sp_composition_matches_single_device(self, causal):
+        """batch over `data` x sequence over `sp` — the 2-D mesh path the
+        multichip dryrun exercises."""
+        devices = jax.devices()
+        if len(devices) < 8:
+            pytest.skip("needs 8 virtual devices")
+        mesh = make_mesh({"data": 4, "sp": 2}, devices=devices[:8])
+        q, k, v = qkv(b=4, t=32, d=8)
+        ref = naive_attention(q, k, v, causal=causal)
+        out = ring_attention(q, k, v, mesh, axis="sp", causal=causal,
+                             batch_axis="data")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_dp_sp_indivisible_batch_raises(self):
+        mesh = make_mesh({"data": 4, "sp": 2}, devices=jax.devices()[:8])
+        q, k, v = qkv(b=3, t=32, d=8)
+        with pytest.raises(ValueError, match="batch"):
+            ring_attention(q, k, v, mesh, axis="sp", batch_axis="data")
