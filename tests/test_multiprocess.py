@@ -188,3 +188,52 @@ class TestTwoProcessTraining:
         fresh = MultiLayerNetwork.from_config_json(conf_json)
         trained = MultiLayerNetwork.from_config_json(conf_json, params=final)
         assert trained.score(x, y) < fresh.score(x, y)
+
+
+class TestTwoProcessWorkRetriever:
+    def test_payloads_ride_shared_work_dir(self, tmp_path):
+        """With WORK_DIR in the run config, payloads travel over the
+        shared filesystem (WorkRetriever data plane) and the tracker RPC
+        carries only descriptors."""
+        x, y = load_iris()
+        rng = np.random.RandomState(0)
+        jobs = [DataSet(np.asarray(x)[i], np.asarray(y)[i]) for i in
+                (rng.choice(len(np.asarray(x)), 32, replace=False)
+                 for _ in range(4))]
+
+        registry_root = str(tmp_path / "registry")
+        work_dir = str(tmp_path / "work")
+        conf_json = iris_conf_json(iters=2)
+        master = MultiProcessMaster(
+            CollectionJobIterator(jobs),
+            run_name="iris-wr",
+            registry=ConfigRegistry(registry_root),
+            performer_class=(
+                "deeplearning4j_tpu.scaleout.perform.NeuralNetWorkPerformer"),
+            performer_conf={"conf_json": conf_json, "epochs": 1},
+            n_workers=1,
+            conf_json=conf_json,
+            work_dir=work_dir,
+        )
+        assert master.work_retriever is not None
+
+        env = dict(os.environ,
+                   PYTHONPATH=REPO_ROOT + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""),
+                   JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "deeplearning4j_tpu.scaleout.launcher", "worker",
+             "--registry", registry_root, "--run", "iris-wr",
+             "--worker-id", "wr-proc"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        try:
+            final = master.run(timeout=120.0)
+            out, _ = proc.communicate(timeout=60)
+            assert proc.returncode == 0, out.decode()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert final is not None
+        # payloads were cleaned up after perform
+        assert os.listdir(work_dir) == []

@@ -280,3 +280,91 @@ class TestSanitize:
             net.output(bad)
         with pytest.raises(ValueError, match="n_in is 4"):
             net.fit(bad, np.eye(3, dtype=np.float32)[[0, 1]])
+
+
+class TestStringGrid:
+    """reference StringGrid/StringCluster/FingerPrintKeyer (core/util)."""
+
+    def test_fingerprint_keyer(self):
+        from deeplearning4j_tpu.utils.string_grid import FingerPrintKeyer
+
+        k = FingerPrintKeyer()
+        assert k.key("Two words") == k.key("WORDS two!")
+        assert k.key("  Café  ") == "cafe"
+        assert k.key("a b a") == "a b"  # uniquified + sorted
+
+    def test_string_cluster(self):
+        from deeplearning4j_tpu.utils.string_grid import StringCluster
+
+        c = StringCluster(["McDonalds", "mcdonalds", "McDonalds", "Burger"])
+        clusters = c.get_clusters()
+        assert len(c) == 2
+        assert clusters[0] == {"McDonalds": 2, "mcdonalds": 1}
+        assert c.canonical("mcdonalds") == "McDonalds"
+
+    def _grid(self):
+        from deeplearning4j_tpu.utils.string_grid import StringGrid
+
+        return StringGrid(",", ["a,1,x", "b,2,y", "a,3,", "c,2,z"])
+
+    def test_grid_io_and_columns(self, tmp_path):
+        from deeplearning4j_tpu.utils.string_grid import StringGrid
+
+        g = self._grid()
+        assert len(g) == 4
+        assert g.get_column(0) == ["a", "b", "a", "c"]
+        path = str(tmp_path / "grid.csv")
+        g.write_lines_to(path)
+        g2 = StringGrid.from_file(path, ",")
+        assert g2.to_lines() == g.to_lines()
+
+    def test_row_and_column_surgery(self):
+        g = self._grid()
+        g.remove_rows_with_empty_column(2)
+        assert len(g) == 3
+        g.select(1, "2")
+        assert len(g.select(1, "2")) == 2
+        g.sort_by(1)
+        assert [r[1] for r in g.rows] == ["1", "2", "2"]
+        g.swap(0, 1)
+        assert g.rows[0][1] == "a"
+        g.remove_columns(2)
+        assert g.num_columns == 2
+        g.prepend_to_each("<", 0)
+        g.append_to_each(">", 0)
+        assert g.rows[0][0] == "<1>"
+
+    def test_split_and_merge(self):
+        from deeplearning4j_tpu.utils.string_grid import StringGrid
+
+        g = StringGrid(",", ["a|b,1", "c|d,2"])
+        g.split(0, "|")
+        assert g.num_columns == 3
+        assert g.rows[0] == ["a", "b", "1"]
+        g.merge(0, 1)
+        assert g.rows[0] == ["ab", "1"]
+
+    def test_duplicates_and_primary_key(self):
+        g = self._grid()
+        dupes = g.get_rows_with_duplicate_values_in_column(0)
+        assert len(dupes) == 2
+        by_key = g.map_by_primary_key(0)
+        assert len(by_key["a"]) == 2
+
+    def test_similarity_filtering(self):
+        from deeplearning4j_tpu.utils.string_grid import StringGrid
+
+        g = StringGrid(",", ["kitten,kitten", "kitten,dog"])
+        close = g.get_all_with_similarity(0.9, 0, 1)
+        assert len(close) == 1
+        g.filter_by_similarity(0.9, 0, 1)
+        assert len(g) == 1
+
+    def test_dedupe_by_cluster(self):
+        from deeplearning4j_tpu.utils.string_grid import StringGrid
+
+        g = StringGrid(",", ["McDonalds,1", "mcdonalds,2",
+                             "McDonalds,3", "KFC,4"])
+        g.dedupe_by_cluster(0)
+        assert g.get_column(0) == ["McDonalds", "McDonalds",
+                                   "McDonalds", "KFC"]
