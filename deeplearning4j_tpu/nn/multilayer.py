@@ -50,6 +50,7 @@ class MultiLayerNetwork:
         self._train_step = None
         self._finetune_solver = None
         self._batch_solver = None
+        self._scan_step = None
         self._pretrain_solvers: Dict[int, Solver] = {}
         self._pending_params = params
         self._iteration_count = 0
@@ -87,6 +88,7 @@ class MultiLayerNetwork:
         self._train_step = None
         self._finetune_solver = None
         self._batch_solver = None
+        self._scan_step = None
         self._pretrain_solvers = {}
         if self._pending_params is not None:
             self.set_parameters(self._pending_params)
@@ -232,6 +234,79 @@ class MultiLayerNetwork:
             self._backprop_fit(x, labels)
         else:
             self.finetune(x, labels)
+
+    def fit_scan(self, x, labels, batch_size: int, epochs: int = 1) -> float:
+        """Whole-epoch training as ONE compiled program: minibatches are
+        a leading scan axis and `lax.scan` carries (params, updater
+        state) through every step on-device — zero per-step host
+        dispatch. Beyond-parity alternative path for the
+        iteration_gradient_descent algorithm.
+
+        Use when host dispatch dominates: many tiny steps, slow host, or
+        driving the device from a high-latency link. For large-matmul
+        configs on a local chip prefer `fit()` — the dispatched per-step
+        program reaches near-peak MXU utilization that XLA does not
+        currently match inside a scan body (measured ~15x per-step gap on
+        v5e for the 784-2048-1024-10 bench config), and `epochs` is a
+        static arg (each distinct value compiles its own program).
+
+        `x`: (N, features); N is truncated to a multiple of batch_size.
+        Returns the final batch's score."""
+        conf0 = self.layers[-1].conf
+        if conf0.optimization_algo.lower() != "iteration_gradient_descent":
+            raise ValueError("fit_scan supports iteration_gradient_descent")
+        x, labels = jnp.asarray(x), jnp.asarray(labels)
+        validate_batch(x, labels, n_in=self.layers[0].conf.n_in
+                       if not self.conf.input_preprocessors.get(0) else None,
+                       n_out=self.layers[-1].conf.n_out, context="fit_scan")
+        n = x.shape[0] // batch_size * batch_size
+        if n == 0:
+            raise ValueError(
+                f"batch_size {batch_size} exceeds {x.shape[0]} examples")
+        xb = x[:n].reshape(n // batch_size, batch_size, *x.shape[1:])
+        yb = labels[:n].reshape(n // batch_size, batch_size,
+                                *labels.shape[1:])
+
+        if self._scan_step is None:
+            updater = NetworkGradientUpdater.for_network(self)
+
+            @partial(jax.jit, donate_argnums=(0, 1), static_argnums=(4,))
+            def epoch(params, upd_state, xb, yb, n_epochs, rng):
+
+                def body(carry, batch):
+                    params, upd_state, rng = carry
+                    bx, by = batch
+                    rng, sub = jax.random.split(rng)
+                    score, grads = jax.value_and_grad(self.loss_fn)(
+                        params, bx, by, rng=sub, training=True)
+                    updates, upd_state = updater.update(
+                        grads, upd_state, params, bx.shape[0])
+                    params = jax.tree_util.tree_map(
+                        lambda p, u: p - u, params, updates)
+                    return (params, upd_state, rng), score
+
+                def one_epoch(carry, _):
+                    carry, scores = jax.lax.scan(body, carry, (xb, yb))
+                    return carry, scores[-1]
+
+                (params, upd_state, _), last_scores = jax.lax.scan(
+                    one_epoch, (params, upd_state, rng), None,
+                    length=n_epochs)
+                return params, upd_state, last_scores[-1]
+
+            self._scan_step = epoch
+
+        if self._updater_state is None:
+            self._updater_state = NetworkGradientUpdater.for_network(
+                self).init(self._params)
+        self._params, self._updater_state, score = self._scan_step(
+            self._params, self._updater_state, xb, yb, int(epochs),
+            self.next_key())
+        self._iteration_count += epochs * (n // batch_size)
+        score = float(score)
+        for listener in self.listeners:
+            listener.iteration_done(self, self._iteration_count - 1, score)
+        return score
 
     def _backprop_fit(self, x, labels) -> None:
         conf0 = self.layers[-1].conf
