@@ -116,7 +116,7 @@ class Word2VecWorkPerformer(WorkerPerformer):
         if w2v is None:
             raise RuntimeError("setup() not called")
         if self._step is None:
-            self._step = w2v._build_step()
+            self._step, _ = w2v._build_step()  # per-batch step only
         sentences: List[str] = list(job.work)
         w2v.sentence_iter = CollectionSentenceIterator(sentences)
 
