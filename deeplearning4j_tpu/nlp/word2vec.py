@@ -25,6 +25,8 @@ jax.random.categorical over precomputed logits.
 from __future__ import annotations
 
 import logging
+import queue
+import threading
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -43,6 +45,33 @@ from deeplearning4j_tpu.nlp.tokenization import (
 from deeplearning4j_tpu.nlp.vocab import VocabCache, build_vocab
 
 log = logging.getLogger(__name__)
+
+
+def _prefetch(iterator, depth: int = 2):
+    """Run a chunk producer in a background thread so host-side pair
+    mining overlaps device training (the reference overlaps via its
+    parallel sentence-training threads, Word2Vec.java:191). Exceptions
+    propagate to the consumer."""
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    _DONE, _ERR = object(), object()
+
+    def produce():
+        try:
+            for item in iterator:
+                q.put(item)
+            q.put(_DONE)
+        except BaseException as e:  # noqa: BLE001 — relay to consumer
+            q.put((_ERR, e))
+
+    t = threading.Thread(target=produce, name="w2v-miner", daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _DONE:
+            return
+        if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
+            raise item[1]
+        yield item
 
 
 class WordVectors:
@@ -395,6 +424,10 @@ class Word2Vec(WordVectors):
         if self.syn0 is None:
             self.reset_weights()
         rng = np.random.RandomState(self.seed)
+        # the miner runs in a prefetch thread concurrently with the
+        # training loop's permutation draws — it needs its OWN RandomState
+        # (numpy RandomState is not thread-safe)
+        mine_rng = np.random.RandomState(self.seed + 1)
         if self._step_cache is None:
             self._step_cache = self._build_step()
         step, step_chunk = self._step_cache
@@ -441,7 +474,8 @@ class Word2Vec(WordVectors):
             return ts
 
         for _ in range(self.iterations):
-            for centers, contexts, n_words in self._iter_pair_chunks(rng):
+            for centers, contexts, n_words in _prefetch(
+                    self._iter_pair_chunks(mine_rng)):
                 self.pairs_trained += centers.size
                 perm = rng.permutation(centers.size)
                 centers = np.concatenate([carry_c, centers[perm]])
