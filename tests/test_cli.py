@@ -82,3 +82,16 @@ def test_train_without_labels_errors(tmp_path, conf_json, capsys):
 def test_missing_required_flag_exits():
     with pytest.raises(SystemExit):
         main(["train", "-i", "x.csv"])  # no --model/--output
+
+
+def test_predict_with_labelled_csv(tmp_path, iris_csv, conf_json, capsys):
+    """predict honors --label-columns so a labelled train/test CSV can be
+    reused; without it, a clear width-mismatch message (not a jax shape
+    error) and exit 2."""
+    out_path = str(tmp_path / "preds.txt")
+    assert main(["predict", "-i", iris_csv, "-m", conf_json,
+                 "-o", out_path, "--label-columns", "1"]) == 0
+    assert len(open(out_path).read().splitlines()) == 150
+    assert main(["predict", "-i", iris_csv, "-m", conf_json,
+                 "-o", out_path]) == 2
+    assert "label-columns" in capsys.readouterr().err
