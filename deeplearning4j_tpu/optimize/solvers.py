@@ -130,7 +130,10 @@ class IterationGradientDescent(BaseOptimizer):
         updater = GradientUpdater(self.conf)
         sign = 1.0 if self.conf.minimize else -1.0
 
-        @jax.jit
+        # donate x/state: outputs alias their HBM instead of reallocating
+        # per iteration (same win as MultiLayerNetwork._get_train_step);
+        # optimize() rebinds both from the outputs every iteration
+        @partial(jax.jit, donate_argnums=(0, 1))
         def step(x, state, key, *data):
             score, g = jax.value_and_grad(self.loss)(x, key, *data)
             # data[0] (when present) is the mini-batch: its leading dim is

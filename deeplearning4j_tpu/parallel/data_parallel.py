@@ -59,10 +59,13 @@ class DataParallelTrainer:
             params = jax.tree_util.tree_map(lambda p, u: p - u, params, updates)
             return params, upd_state, score
 
+        # donate params/updater state (outputs alias their HBM; fit()
+        # rebinds both from the outputs every step)
         return jax.jit(
             step,
             in_shardings=(rep, rep, bsh, bsh, rep),
             out_shardings=(rep, rep, rep),
+            donate_argnums=(0, 1),
         )
 
     def pad_batch(self, x: np.ndarray, labels: np.ndarray):
@@ -85,18 +88,22 @@ class DataParallelTrainer:
         params = net._params
         score = None
         steps = 0
-        with self.mesh:
-            for _ in range(epochs):
-                iterator.reset()
-                for ds in iterator:
-                    x, labels = self.pad_batch(np.asarray(ds.features),
-                                               np.asarray(ds.labels))
-                    params, upd_state, score = self._step(
-                        params, upd_state, jnp.asarray(x), jnp.asarray(labels),
-                        net.next_key())
-                    steps += 1
-        net._params = params
-        net._updater_state = upd_state
+        try:
+            with self.mesh:
+                for _ in range(epochs):
+                    iterator.reset()
+                    for ds in iterator:
+                        x, labels = self.pad_batch(np.asarray(ds.features),
+                                                   np.asarray(ds.labels))
+                        params, upd_state, score = self._step(
+                            params, upd_state, jnp.asarray(x),
+                            jnp.asarray(labels), net.next_key())
+                        steps += 1
+        finally:
+            # the step donates the params/state passed in — the net must
+            # always point at the live outputs, even on an interrupted fit
+            net._params = params
+            net._updater_state = upd_state
         if steps:
             for listener in net.listeners:
                 listener.iteration_done(net, steps - 1, float(score))
