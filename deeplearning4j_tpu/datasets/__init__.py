@@ -7,6 +7,9 @@ from deeplearning4j_tpu.datasets.api import (  # noqa: F401
     SamplingDataSetIterator,
     TestDataSetIterator,
 )
+from deeplearning4j_tpu.datasets.async_iterator import (  # noqa: F401
+    AsyncDataSetIterator,
+)
 from deeplearning4j_tpu.datasets.mnist import (  # noqa: F401
     MnistDataSetIterator,
     RawMnistDataSetIterator,

@@ -1,0 +1,121 @@
+"""Async prefetching DataSetIterator over the native BatchQueue.
+
+Parity: the reference's data pipeline feeds the training loop
+synchronously (DataSetIterator.next() does its IO/assembly inline);
+DL4J grew an AsyncDataSetIterator later for exactly this reason. Here
+the wrapper pairs with the C++ bounded ring (`runtime/native/native.cpp`
+dl4j_queue_*, consumed through `runtime.native_loader.BatchQueue`): a
+producer thread drains the source iterator and pushes (features, labels)
+through two lock-stepped native queues, so host-side batch assembly
+(CSV/IDX decode, window featurization, augmentation) overlaps the device
+step instead of serializing with it.
+
+TPU-relevant because the device step is often sub-millisecond: any
+synchronous host work between steps stalls the chip. capacity bounds
+the look-ahead (double/triple buffering), keeping memory flat on
+arbitrarily long streams.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from deeplearning4j_tpu.datasets.api import DataSet, DataSetIterator
+from deeplearning4j_tpu.runtime.native_loader import BatchQueue
+
+__all__ = ["AsyncDataSetIterator"]
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Wrap any DataSetIterator; batches are produced ahead of
+    consumption on a background thread through the native queue."""
+
+    def __init__(self, source: DataSetIterator, capacity: int = 4):
+        self.source = source
+        self.capacity = capacity
+        self._fq: Optional[BatchQueue] = None
+        self._lq: Optional[BatchQueue] = None
+        self._producer: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._next: Optional[DataSet] = None  # one-batch lookahead
+        super().__init__(batch_size=source.batch(),
+                         num_examples=source.num_examples()
+                         if self._safe_num_examples() else -1)
+        self._start()
+
+    def _safe_num_examples(self) -> bool:
+        try:
+            self.source.num_examples()
+            return True
+        except NotImplementedError:
+            return False
+
+    # ---------------------------------------------------------- producer
+    def _start(self) -> None:
+        self._fq = BatchQueue(self.capacity)
+        self._lq = BatchQueue(self.capacity)
+        self._error = None
+        self._next = None
+
+        def produce():
+            try:
+                self.source.reset()
+                while self.source.has_next():
+                    ds = self.source.next()
+                    if not self._fq.push(ds.features):
+                        return  # consumer closed
+                    if not self._lq.push(ds.labels):
+                        return
+            except BaseException as e:  # noqa: BLE001 — relay to consumer
+                self._error = e
+            finally:
+                self._fq.close()
+                self._lq.close()
+
+        self._producer = threading.Thread(target=produce,
+                                          name="async-dsi", daemon=True)
+        self._producer.start()
+
+    def _pop(self) -> Optional[DataSet]:
+        f = self._fq.pop()
+        if f is None:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            return None
+        labels = self._lq.pop()
+        return DataSet(f, labels)
+
+    # --------------------------------------------------- iterator surface
+    def input_columns(self) -> int:
+        return self.source.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self.source.total_outcomes()
+
+    def has_next(self) -> bool:
+        if self._next is None:
+            self._next = self._pop()
+        return self._next is not None
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        ds, self._next = self._next, None
+        if self.pre_processor is not None:
+            ds = self.pre_processor(ds)
+        return ds
+
+    def reset(self) -> None:
+        """Tear down the in-flight producer and restart from the source's
+        beginning."""
+        self._fq.close()
+        self._lq.close()
+        if self._producer is not None:
+            self._producer.join(timeout=10.0)
+        self._start()
+
+    def close(self) -> None:
+        self._fq.close()
+        self._lq.close()

@@ -6,6 +6,7 @@ import os
 import struct
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.datasets import (
     CSVDataSetIterator,
@@ -102,3 +103,91 @@ def test_csv_iterator(tmp_path):
     ds = it.next()
     assert ds.features.shape == (5, 2)
     assert ds.labels.shape == (5, 2)
+
+
+class TestAsyncDataSetIterator:
+    """Prefetching wrapper over the native BatchQueue (runtime/native
+    dl4j_queue_*): host batch assembly overlaps the device step."""
+
+    def _source(self, n=64, batch=16):
+        from deeplearning4j_tpu.datasets import ListDataSetIterator
+        from deeplearning4j_tpu.datasets.api import DataSet
+
+        rng = np.random.RandomState(0)
+        return ListDataSetIterator(
+            DataSet(rng.rand(n, 4).astype(np.float32),
+                    np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]),
+            batch_size=batch)
+
+    def test_matches_source_order_and_content(self):
+        from deeplearning4j_tpu.datasets import AsyncDataSetIterator
+
+        src = self._source()
+        expected = [src.next() for _ in range(4)]
+        it = AsyncDataSetIterator(self._source())
+        got = []
+        while it.has_next():
+            got.append(it.next())
+        assert len(got) == len(expected)
+        for a, b in zip(got, expected):
+            np.testing.assert_allclose(a.features, b.features, rtol=1e-6)
+            np.testing.assert_allclose(a.labels, b.labels)
+        assert it.input_columns() == 4
+        assert it.total_outcomes() == 3
+
+    def test_reset_restarts_stream(self):
+        from deeplearning4j_tpu.datasets import AsyncDataSetIterator
+
+        it = AsyncDataSetIterator(self._source())
+        first = it.next()
+        while it.has_next():
+            it.next()
+        it.reset()
+        again = it.next()
+        np.testing.assert_allclose(again.features, first.features, rtol=1e-6)
+        it.close()
+
+    def test_producer_error_propagates(self):
+        from deeplearning4j_tpu.datasets import AsyncDataSetIterator
+        from deeplearning4j_tpu.datasets.api import DataSetIterator
+
+        class Exploding(DataSetIterator):
+            def __init__(self):
+                super().__init__(batch_size=4, num_examples=8)
+
+            def input_columns(self):
+                return 2
+
+            def total_outcomes(self):
+                return 2
+
+            def has_next(self):
+                return True
+
+            def next(self, num=None):
+                raise RuntimeError("bad shard")
+
+        it = AsyncDataSetIterator(Exploding())
+        with pytest.raises(RuntimeError, match="bad shard"):
+            while it.has_next():
+                it.next()
+
+    def test_trains_through_network(self):
+        """End-to-end consumer: MultiLayerNetwork.fit over the async
+        iterator."""
+        from deeplearning4j_tpu.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.datasets import AsyncDataSetIterator
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (NeuralNetConfiguration.builder()
+                .lr(0.1).n_in(4).activation_function("tanh")
+                .optimization_algo("iteration_gradient_descent")
+                .num_iterations(2).use_adagrad(False)
+                .list(2).hidden_layer_sizes([8])
+                .override(1, layer="output", loss_function="mcxent",
+                          activation_function="softmax", n_out=3)
+                .pretrain(False).build())
+        net = MultiLayerNetwork(conf)
+        it = AsyncDataSetIterator(self._source(n=128, batch=32))
+        net.fit(it, epochs=2)  # reset() between epochs restarts producer
+        assert net._iteration_count > 0
