@@ -5,3 +5,4 @@ from deeplearning4j_tpu.parallel.data_parallel import (  # noqa: F401
 from deeplearning4j_tpu.parallel.averaging import (  # noqa: F401
     ParameterAveragingTrainer,
 )
+from deeplearning4j_tpu.parallel import multihost  # noqa: F401

@@ -1,0 +1,95 @@
+"""Multi-host communication backend test: two separately-launched CPU
+processes join one JAX distributed runtime (parallel/multihost.py) and
+exchange gradients through real cross-process collectives (Gloo on CPU;
+ICI/DCN on pods) — the validation tier for SURVEY §5's communication
+backend that the in-process virtual mesh cannot provide."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import sys
+proc_id, nprocs, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
+                                 sys.argv[3], sys.argv[4])
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from deeplearning4j_tpu.parallel import multihost
+
+multihost.initialize(f"127.0.0.1:{port}", nprocs, proc_id)
+info = multihost.process_info()
+assert info["process_count"] == nprocs, info
+assert info["global_devices"] == nprocs, info
+
+import numpy as np
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.datasets import ListDataSetIterator
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.datasets.iris import load_iris
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import DataParallelTrainer
+
+conf = (NeuralNetConfiguration.builder()
+        .lr(0.1).n_in(4).activation_function("tanh")
+        .optimization_algo("iteration_gradient_descent")
+        .num_iterations(1).use_adagrad(False)
+        .list(2).hidden_layer_sizes([8])
+        .override(1, layer="output", loss_function="mcxent",
+                  activation_function="softmax", n_out=3)
+        .pretrain(False).build())
+net = MultiLayerNetwork(conf)  # same seed in conf => same init everywhere
+x, y = load_iris()
+x, y = np.asarray(x)[:144], np.asarray(y)[:144]
+
+mesh = multihost.global_data_mesh()
+trainer = DataParallelTrainer(net, mesh)
+it = ListDataSetIterator(DataSet(x, y), batch_size=48)
+trainer.fit(it, epochs=3)
+
+params = np.asarray(net.params())
+np.save(f"{outdir}/params_{proc_id}.npy", params)
+print(f"proc {proc_id} done, score={net.score(x, y):.4f}", flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_data_parallel_training(tmp_path):
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    env = dict(os.environ,
+               PYTHONPATH=REPO_ROOT + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.pop("XLA_FLAGS", None)  # no virtual device multiplication here
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), "2", str(port),
+             str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out.decode())
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+    # gradient psum makes every process's params identical
+    a = np.load(tmp_path / "params_0.npy")
+    b = np.load(tmp_path / "params_1.npy")
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+    # and training actually moved the params
+    assert np.abs(a).sum() > 0
