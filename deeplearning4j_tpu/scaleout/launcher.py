@@ -140,11 +140,10 @@ def run_worker(*, registry_root: str, run_name: str, worker_id: str,
 
 def _maybe_init_jax_distributed(args) -> None:
     if args.jax_coordinator:
-        import jax
-        jax.distributed.initialize(
-            coordinator_address=args.jax_coordinator,
-            num_processes=args.num_processes,
-            process_id=args.process_id)
+        from deeplearning4j_tpu.parallel import multihost
+        multihost.initialize(args.jax_coordinator,
+                             num_processes=args.num_processes,
+                             process_id=args.process_id)
 
 
 def main(argv=None) -> int:
