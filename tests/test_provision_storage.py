@@ -154,3 +154,33 @@ class TestProvisioning:
         rc, out = results["w0"]
         assert rc == -1
         assert "setup script failed" in out
+
+
+class TestSshTransport:
+    """Command construction only — no live ssh in the test image."""
+
+    def test_ssh_command_shape(self):
+        from deeplearning4j_tpu.scaleout.provision import SshTransport
+
+        t = SshTransport("worker-1.example", user="trainer", port=2222,
+                         key_file="/keys/id_ed25519")
+        base = t._ssh_base()
+        assert base[0] == "ssh"
+        assert "-p" in base and base[base.index("-p") + 1] == "2222"
+        assert "-i" in base and base[base.index("-i") + 1] == "/keys/id_ed25519"
+        assert "BatchMode=yes" in base  # never prompt for passwords
+        assert base[-1] == "trainer@worker-1.example"
+
+    def test_ssh_without_user_or_key(self):
+        from deeplearning4j_tpu.scaleout.provision import SshTransport
+
+        base = SshTransport("host-a")._ssh_base()
+        assert base[-1] == "host-a"
+        assert "-i" not in base
+
+    def test_upload_failure_raises(self):
+        from deeplearning4j_tpu.scaleout.provision import SshTransport
+
+        t = SshTransport("256.0.0.1", connect_timeout=1)  # unroutable
+        with pytest.raises(RuntimeError, match="scp"):
+            t.upload("/etc/hostname", "/tmp/x")
