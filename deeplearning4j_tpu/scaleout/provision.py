@@ -102,7 +102,12 @@ class SshTransport(Transport):
         if self.key_file:
             cmd += ["-i", self.key_file]
         cmd += [local_path, f"{self.target}:{remote_path}"]
-        proc = subprocess.run(cmd, capture_output=True, text=True)
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+        except FileNotFoundError as e:
+            raise RuntimeError(
+                f"scp to {self.target} failed: OpenSSH client not "
+                f"installed ({e})") from e
         if proc.returncode != 0:
             raise RuntimeError(f"scp to {self.target} failed: {proc.stderr}")
 
@@ -110,8 +115,11 @@ class SshTransport(Transport):
         remote = " ".join(command)
         if detach:
             remote = f"nohup {remote} >/dev/null 2>&1 & echo $!"
-        proc = subprocess.run(self._ssh_base() + [remote],
-                              capture_output=True, text=True)
+        try:
+            proc = subprocess.run(self._ssh_base() + [remote],
+                                  capture_output=True, text=True)
+        except FileNotFoundError as e:
+            return 127, f"ssh client not installed: {e}"
         return proc.returncode, proc.stdout + proc.stderr
 
 
