@@ -117,12 +117,26 @@ def _flash_forward(q, k, v, causal: bool, q_tile: int, block_k: int,
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal: bool = False, q_tile: int = 128,
-                    block_k: int = 128, interpret: bool = False):
-    """Pallas flash attention. q/k/v: (batch[*heads], T, d); T divisible
-    by the tile sizes (else falls back to blockwise). Set interpret=True
-    off-TPU."""
-    t_q, t_k = q.shape[1], k.shape[1]
+def flash_attention(q, k, v, causal: bool = False, q_tile: int = 256,
+                    block_k: int = 512, interpret: bool = False):
+    """Pallas flash attention. q/k/v: (batch[*heads], T, d). Tile sizes
+    clamp to T, so short sequences stay on the kernel; T not divisible
+    by the (clamped) tiles falls back to blockwise. Set interpret=True
+    off-TPU.
+
+    Defaults tuned on v5e at (4x8)x2048x64 bf16 causal: 256/512 measured
+    ~1.4x faster than 128/128 (11.3 vs 16.0 ms with hard D2H sync).
+
+    NOTE: sequence length is axis -2 (NOT axis 1 — a 4-D (B, H, T, d)
+    input's axis 1 is heads; reading it as T silently routed every 4-D
+    call to the blockwise fallback)."""
+    t_q, t_k = q.shape[-2], k.shape[-2]
+    # clamp tiles to shorter sequences, but only lane-aligned ones —
+    # ragged lengths go to the blockwise fallback
+    if t_q < q_tile and t_q % 128 == 0:
+        q_tile = t_q
+    if t_k < block_k and t_k % 128 == 0:
+        block_k = t_k
     if t_q % q_tile or t_k % block_k:
         return blockwise_attention(q, k, v, causal=causal)
     out = _flash_forward(q.reshape(-1, t_q, q.shape[-1]),

@@ -248,3 +248,27 @@ class TestSelfAttentionLayer:
         q, k, v = qkv(b=3, t=32, d=8)
         with pytest.raises(ValueError, match="batch"):
             ring_attention(q, k, v, mesh, axis="sp", batch_axis="data")
+
+    def test_4d_inputs_take_the_kernel_path(self, monkeypatch):
+        """Regression: sequence length is axis -2; reading axis 1 (heads)
+        silently routed every (B, H, T, d) call to the blockwise
+        fallback, so the Pallas kernel never ran on multi-head inputs."""
+        import deeplearning4j_tpu.attention.flash_pallas as fp
+
+        calls = {"n": 0}
+        real = fp._flash_forward
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return real(*a, **kw)
+
+        monkeypatch.setattr(fp, "_flash_forward", counting)
+        q, k, v = qkv(b=2, t=256, d=16)
+        q4 = q.reshape(2, 1, 256, 16)
+        k4 = k.reshape(2, 1, 256, 16)
+        v4 = v.reshape(2, 1, 256, 16)
+        ref = naive_attention(q4, k4, v4, causal=True)
+        out = fp.flash_attention(q4, k4, v4, causal=True, interpret=True)
+        assert calls["n"] == 1, "4-D input fell back instead of tiling"
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
