@@ -2,8 +2,10 @@
 
 Beyond-reference capability (the reference predates attention): a
 single-head self-attention block usable in a MultiLayerNetwork stack on
-(batch, T, d) inputs, computing through `blockwise_attention` so long
-sequences stay O(T) in memory. With a mesh configured, callers can swap
+(batch, T, d) inputs. The forward computes through `flash_attention` —
+the Pallas kernel on TPU for tile-aligned sequences, transparently the
+blockwise form elsewhere (same O(T) memory either way; the custom VJP
+recomputes through blockwise). With a mesh configured, callers can swap
 the inner call for `ring_attention` (sequence parallelism).
 """
 
@@ -14,7 +16,7 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.attention.blockwise import blockwise_attention
+from deeplearning4j_tpu.attention.flash_pallas import flash_attention
 from deeplearning4j_tpu.nn.layers import (BaseLayer, apply_dropout,
                                           register_layer)
 
@@ -49,6 +51,10 @@ class SelfAttentionLayer(BaseLayer):
         q = (x.astype(cd) @ params["Wq"].astype(cd))
         k = (x.astype(cd) @ params["Wk"].astype(cd))
         v = (x.astype(cd) @ params["Wv"].astype(cd))
-        out = blockwise_attention(q, k, v, causal=self.is_causal())
+        # interpret mode off-TPU: the kernel path still runs (slowly) under
+        # the Pallas interpreter so tests exercise the same code path
+        on_tpu = jax.devices()[0].platform == "tpu"
+        out = flash_attention(q, k, v, causal=self.is_causal(),
+                              interpret=not on_tpu)
         out = out.astype(jnp.dtype(self.conf.dtype)) @ params["Wo"]
         return apply_dropout(rng, out, self.conf.dropout, training)
