@@ -7,9 +7,10 @@ from deeplearning4j_tpu.datasets.mnist import synthetic_mnist
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
 conf = (NeuralNetConfiguration.builder()
-        .lr(0.05).n_in(784).activation_function("sigmoid")
+        .lr(2.0)  # adagrad master step; update is lr/batch-scaled (reference semantics)
+        .n_in(784).activation_function("sigmoid")
         .optimization_algo("iteration_gradient_descent")
-        .num_iterations(10).batch_size(512)
+        .num_iterations(40).batch_size(512)
         .list(3).hidden_layer_sizes([256, 128])
         .override(0, layer="rbm", k=1)
         .override(1, layer="rbm", k=1)

@@ -4,11 +4,17 @@ from deeplearning4j_tpu.nlp import (LabelAwareSentenceIterator, Word2Vec,
                                     Word2VecDataSetIterator)
 
 corpus = ["the cat sat on the mat", "the dog sat on the rug",
-          "the king wears the crown", "the queen wears the crown"] * 50
+          "the cat and the dog play in the yard",
+          "the king wears the crown in the castle",
+          "the queen wears the crown in the castle",
+          "a royal king and a royal queen sit on the throne"] * 40
 
-w2v = Word2Vec(corpus, layer_size=64, window=3, min_word_frequency=2,
-               negative=5, iterations=20, seed=7).fit()
+w2v = Word2Vec(corpus, layer_size=32, window=3, min_word_frequency=3,
+               learning_rate=0.1, negative=5, batch_pairs=256,
+               iterations=40, seed=7).fit()
 print("nearest to 'king':", w2v.words_nearest("king", n=3))
+print("king~queen:", round(w2v.similarity("king", "queen"), 3),
+      " king~cat:", round(w2v.similarity("king", "cat"), 3))
 
 it = Word2VecDataSetIterator(
     w2v,
