@@ -17,6 +17,8 @@ from deeplearning4j_tpu.nlp.tokenization import (  # noqa: F401
     DefaultTokenizer,
     DefaultTokenizerFactory,
     NGramTokenizerFactory,
+    WhitespaceTokenizer,
+    WhitespaceTokenizerFactory,
 )
 from deeplearning4j_tpu.nlp.sentence_iterator import (  # noqa: F401
     CollectionSentenceIterator,

@@ -51,6 +51,34 @@ class DefaultTokenizerFactory(TokenizerFactory):
         return DefaultTokenizer(text, self.pre_processor)
 
 
+class WhitespaceTokenizer(Tokenizer):
+    """Plain whitespace tokenization — the reference's ACTUAL
+    DefaultTokenizer (text/tokenization/tokenizer/DefaultTokenizer.java:
+    a java.util.StringTokenizer: no lowercasing, no punctuation strip).
+    ~5x faster than the regex tokenizer; the right choice for
+    pre-cleaned/space-separated corpora (text8-style) where tokenization
+    is the Word2Vec pipeline's bottleneck."""
+
+    def __init__(self, text: str,
+                 pre_processor: Optional[Callable[[str], str]] = None):
+        self.text = text
+        self.pre_processor = pre_processor
+
+    def tokens(self) -> List[str]:
+        toks = self.text.split()
+        if self.pre_processor is not None:
+            toks = [t for t in (self.pre_processor(t) for t in toks) if t]
+        return toks
+
+
+class WhitespaceTokenizerFactory(TokenizerFactory):
+    def __init__(self, pre_processor: Optional[Callable[[str], str]] = None):
+        self.pre_processor = pre_processor
+
+    def create(self, text: str) -> WhitespaceTokenizer:
+        return WhitespaceTokenizer(text, self.pre_processor)
+
+
 class NGramTokenizerFactory(TokenizerFactory):
     """Emit n-grams (joined by '_') over the base tokens
     (reference NGramTokenizerFactory)."""

@@ -429,3 +429,31 @@ class TestWord2VecDataSetIterator:
 
         with pytest.raises(ValueError):
             viterbi_smooth(np.ones(5))
+
+
+class TestWhitespaceTokenizer:
+    """reference DefaultTokenizer.java is a plain whitespace
+    StringTokenizer — this is its exact-parity fast path."""
+
+    def test_splits_on_whitespace_only(self):
+        from deeplearning4j_tpu.nlp import WhitespaceTokenizerFactory
+
+        toks = WhitespaceTokenizerFactory().tokenize("Hello, World!  it's\tme")
+        assert toks == ["Hello,", "World!", "it's", "me"]  # no lowering/strip
+
+    def test_preprocessor_applied_and_empties_dropped(self):
+        from deeplearning4j_tpu.nlp import WhitespaceTokenizerFactory
+
+        f = WhitespaceTokenizerFactory(
+            pre_processor=lambda t: t.strip(",!").lower())
+        assert f.tokenize("Hello, World! ,") == ["hello", "world"]
+
+    def test_word2vec_accepts_it(self):
+        from deeplearning4j_tpu.nlp import (Word2Vec,
+                                            WhitespaceTokenizerFactory)
+
+        corpus = ["alpha beta gamma delta"] * 30
+        w2v = Word2Vec(corpus, layer_size=8, window=2, min_word_frequency=1,
+                       iterations=2, seed=0,
+                       tokenizer_factory=WhitespaceTokenizerFactory()).fit()
+        assert w2v.has_word("alpha")
