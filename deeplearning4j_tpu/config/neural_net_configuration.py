@@ -50,6 +50,8 @@ class NeuralNetConfiguration:
     k: int = 1
     #: causal masking for attention layers (beyond-reference capability)
     causal: bool = False
+    #: attention heads (self_attention layer; n_out must divide by it)
+    n_heads: int = 1
     # --- architecture ---
     layer: str = "dense"  # layer type name, resolved via nn.layers registry
     n_in: int = 0

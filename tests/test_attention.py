@@ -297,3 +297,46 @@ class TestSelfAttentionLayer:
         for a, b in zip(gf, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-4)
+
+
+class TestMultiHead:
+    def _layer(self, n_heads, d=16):
+        from deeplearning4j_tpu.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import make_layer
+
+        conf = NeuralNetConfiguration(layer="self_attention", n_in=d,
+                                      n_out=d, n_heads=n_heads,
+                                      causal=True, seed=0)
+        return make_layer(conf)
+
+    def test_multi_head_shapes_and_grad(self):
+        layer = self._layer(4)
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 16))
+        out = layer.activate(params, x)
+        assert out.shape == (2, 64, 16)
+
+        def loss(p):
+            return jnp.sum(layer.activate(p, x) ** 2)
+
+        grads = jax.grad(loss)(params)
+        assert all(float(jnp.abs(g).sum()) > 0 for g in grads.values())
+
+    def test_single_head_unchanged_semantics(self):
+        """n_heads=1 must equal the pre-multi-head layer (one full-width
+        attention over the projections)."""
+        layer = self._layer(1)
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 16))
+        out = layer.activate(params, x)
+        q = x @ params["Wq"]
+        k = x @ params["Wk"]
+        v = x @ params["Wv"]
+        ref = naive_attention(q, k, v, causal=True) @ params["Wo"]
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_indivisible_heads_rejected(self):
+        layer = self._layer(3)
+        with pytest.raises(ValueError, match="divisible"):
+            layer.init_params(jax.random.PRNGKey(0))
