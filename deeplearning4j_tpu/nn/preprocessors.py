@@ -56,6 +56,35 @@ class BinomialSamplingPreProcessor(PreProcessor):
         return jax.random.bernoulli(rng, jnp.clip(x, 0.0, 1.0)).astype(x.dtype)
 
 
+@register_preprocessor("aggregate")
+class AggregatePreProcessor(PreProcessor):
+    """Chain preprocessors in order (reference AggregatePreProcessor.java:
+    apply each in sequence)."""
+
+    def __init__(self, preprocessors: Sequence):
+        from deeplearning4j_tpu.config.multi_layer_configuration import (
+            PREPROCESSOR_REGISTRY)
+
+        # accept live PreProcessors or their {"name", "args"} wire form
+        # (the JSON round trip nests children inside this one's args)
+        self.preprocessors = [
+            p if isinstance(p, PreProcessor)
+            else PREPROCESSOR_REGISTRY[p["name"]](**p.get("args", {}))
+            for p in preprocessors]
+
+    def serializable_args(self):
+        return {"preprocessors": [
+            {"name": p.registry_name, "args": p.serializable_args()}
+            for p in self.preprocessors]}
+
+    def __call__(self, x, *, rng=None):
+        keys = (jax.random.split(rng, len(self.preprocessors))
+                if rng is not None else [None] * len(self.preprocessors))
+        for p, k in zip(self.preprocessors, keys):
+            x = p(x, rng=k)
+        return x
+
+
 @register_preprocessor("conv_input")
 class ConvolutionInputPreProcessor(PreProcessor):
     """Flat (B, rows*cols*channels) -> NHWC (B, rows, cols, channels).

@@ -60,3 +60,32 @@ def test_momentum_schedule():
     assert conf.momentum_for_iteration(0) == 0.5
     assert conf.momentum_for_iteration(3) == 0.9
     assert conf.momentum_for_iteration(10) == 0.99
+
+
+def test_aggregate_preprocessor_round_trip():
+    """reference AggregatePreProcessor: chained preprocessors survive the
+    JSON wire (children nest inside the aggregate's args)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.config.multi_layer_configuration import (
+        MultiLayerConfiguration)
+    from deeplearning4j_tpu.nn.preprocessors import (
+        AggregatePreProcessor, ConvolutionPostProcessor, ReshapePreProcessor)
+
+    agg = AggregatePreProcessor([ReshapePreProcessor([2, 2]),
+                                 ConvolutionPostProcessor()])
+    x = np.arange(8.0).reshape(2, 4)
+    out = agg(x)
+    assert out.shape == (2, 4)  # reshaped to (2,2,2) then flattened back
+
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(4).list(2).hidden_layer_sizes([3])
+            .override(1, layer="output", loss_function="mcxent", n_out=2)
+            .input_preprocessor(0, agg)
+            .pretrain(False).build())
+    restored = MultiLayerConfiguration.from_json(conf.to_json())
+    agg2 = restored.input_preprocessors[0]
+    assert isinstance(agg2, AggregatePreProcessor)
+    assert len(agg2.preprocessors) == 2
+    np.testing.assert_allclose(np.asarray(agg2(x)), np.asarray(out))
