@@ -36,6 +36,7 @@ from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord  # noqa: F401
 from deeplearning4j_tpu.nlp.huffman import build_huffman  # noqa: F401
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
 from deeplearning4j_tpu.nlp.word2vec_iterator import (  # noqa: F401
+    Word2VecDataFetcher,
     Word2VecDataSetIterator,
     viterbi_smooth,
 )

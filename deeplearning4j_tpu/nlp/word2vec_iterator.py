@@ -32,7 +32,8 @@ from deeplearning4j_tpu.nlp.windows import Window, window_as_vector, windows
 from deeplearning4j_tpu.utils.disk_based_queue import DiskBasedQueue
 from deeplearning4j_tpu.utils.viterbi import Viterbi
 
-__all__ = ["Word2VecDataSetIterator", "viterbi_smooth"]
+__all__ = ["Word2VecDataSetIterator", "Word2VecDataFetcher",
+           "viterbi_smooth"]
 
 
 class Word2VecDataSetIterator(DataSetIterator):
@@ -138,6 +139,34 @@ class Word2VecDataSetIterator(DataSetIterator):
         if self.pre_processor is not None:
             ds = self.pre_processor(ds)
         return ds
+
+
+class Word2VecDataFetcher(Word2VecDataSetIterator):
+    """File-corpus variant (reference Word2VecDataFetcher.java: iterate
+    text files, window each line, featurize through the trained
+    vectors). Labels come from each file's parent directory (the
+    directory-per-class layout `LabelAwareDocumentIterator` reads);
+    every non-empty line is one sentence."""
+
+    def __init__(self, vec, corpus_root: str, labels=None, batch: int = 10,
+                 **kw):
+        from deeplearning4j_tpu.nlp.documents import (
+            LabelAwareDocumentIterator)
+        from deeplearning4j_tpu.nlp.sentence_iterator import (
+            LabelAwareSentenceIterator)
+
+        docs = LabelAwareDocumentIterator(corpus_root)
+        pairs = []
+        while docs.has_next():
+            text = docs.next_document()
+            label = docs.current_label()
+            for line in text.splitlines():
+                if line.strip():
+                    pairs.append((label, line))
+        if labels is None:
+            labels = sorted({label for label, _ in pairs})
+        super().__init__(vec, LabelAwareSentenceIterator(pairs),
+                         labels=labels, batch=batch, **kw)
 
 
 def viterbi_smooth(predictions: np.ndarray,

@@ -457,3 +457,29 @@ class TestWhitespaceTokenizer:
                        iterations=2, seed=0,
                        tokenizer_factory=WhitespaceTokenizerFactory()).fit()
         assert w2v.has_word("alpha")
+
+
+class TestWord2VecDataFetcher:
+    """reference Word2VecDataFetcher: directory corpus -> window DataSets
+    over trained vectors."""
+
+    def test_directory_corpus(self, tmp_path):
+        from deeplearning4j_tpu.nlp import Word2Vec, Word2VecDataFetcher
+
+        for label, lines in [("animals", ["the cat sat on the mat",
+                                          "the dog sat on the rug"]),
+                             ("finance", ["stocks fell on the market"])]:
+            d = tmp_path / label
+            d.mkdir()
+            (d / "doc.txt").write_text("\n".join(lines) + "\n")
+
+        corpus = ["the cat sat on the mat the dog sat on the rug "
+                  "stocks fell on the market"] * 10
+        vec = Word2Vec(corpus, layer_size=8, window=3, min_word_frequency=1,
+                       iterations=1, seed=0).fit()
+        fetcher = Word2VecDataFetcher(vec, str(tmp_path), batch=64)
+        assert fetcher.total_outcomes() == 2  # labels from directories
+        ds = fetcher.next()
+        assert ds.features.shape == (6 + 6 + 5, 8 * 3)
+        assert ds.labels.shape[1] == 2
+        assert np.all(ds.labels.sum(axis=1) == 1.0)
