@@ -6,3 +6,6 @@ from deeplearning4j_tpu.parallel.averaging import (  # noqa: F401
     ParameterAveragingTrainer,
 )
 from deeplearning4j_tpu.parallel import multihost  # noqa: F401
+from deeplearning4j_tpu.parallel.sharded_update import (  # noqa: F401
+    ShardedUpdateTrainer,
+)
