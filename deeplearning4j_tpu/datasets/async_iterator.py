@@ -31,9 +31,11 @@ class AsyncDataSetIterator(DataSetIterator):
     """Wrap any DataSetIterator; batches are produced ahead of
     consumption on a background thread through the native queue."""
 
-    def __init__(self, source: DataSetIterator, capacity: int = 4):
+    def __init__(self, source: DataSetIterator, capacity: int = 4,
+                 reset_timeout: float = 10.0):
         self.source = source
         self.capacity = capacity
+        self.reset_timeout = reset_timeout  # join wait for a slow source
         self._fq: Optional[BatchQueue] = None
         self._lq: Optional[BatchQueue] = None
         self._producer: Optional[threading.Thread] = None
@@ -113,7 +115,15 @@ class AsyncDataSetIterator(DataSetIterator):
         self._fq.close()
         self._lq.close()
         if self._producer is not None:
-            self._producer.join(timeout=10.0)
+            self._producer.join(timeout=self.reset_timeout)
+            if self._producer.is_alive():
+                # a second producer over the same source would interleave
+                # batches with this stuck one — fail loudly instead
+                raise RuntimeError(
+                    "AsyncDataSetIterator.reset: producer thread still "
+                    f"running (source.next() blocked >{self.reset_timeout}"
+                    "s); raise reset_timeout for slow sources rather than "
+                    "risking two producers over the same source")
         self._start()
 
     def close(self) -> None:

@@ -58,11 +58,17 @@ class ShardedUpdateTrainer(DataParallelTrainer):
         self._flat_state = None
 
     def _prep_tables(self, network) -> None:
+        # ravel_pytree flattens the string-keyed params dict in SORTED KEY
+        # order ('0', '1', '10', '11', '2', ...), which diverges from
+        # numeric layer order at 11+ layers — the tables must be built in
+        # that same flatten order or hyperparameters land on the wrong
+        # layers' slices.
         sizes = []
         lrs, adagrads, moms = [], [], []
         self._layer_confs = []
-        for i, layer in enumerate(network.layers):
-            flat_i, _ = ravel_pytree(network._params[str(i)])
+        for key in sorted(network._params):
+            layer = network.layers[int(key)]
+            flat_i, _ = ravel_pytree(network._params[key])
             sizes.append(flat_i.size)
             c = layer.conf
             self._layer_confs.append(c)

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import logging
 import os
+import shlex
 import shutil
 import subprocess
 import sys
@@ -112,7 +113,10 @@ class SshTransport(Transport):
             raise RuntimeError(f"scp to {self.target} failed: {proc.stderr}")
 
     def run(self, command, detach=False):
-        remote = " ".join(command)
+        # each element shell-quoted: the remote side runs through a shell,
+        # so paths/run-names with spaces or metacharacters must not split
+        # or be interpreted (the detach path additionally wraps in nohup)
+        remote = " ".join(shlex.quote(c) for c in command)
         if detach:
             remote = f"nohup {remote} >/dev/null 2>&1 & echo $!"
         try:

@@ -101,6 +101,42 @@ class TestShardedUpdateTrainer:
         assert hist.sharding.spec == P("data")
         assert vel.sharding.spec == P("data")
 
+    def test_matches_plain_dp_with_11_plus_layers(self):
+        """Regression: ravel_pytree flattens the string-keyed params dict
+        lexicographically ('0','1','10','11','2',...), so at 11+ layers
+        the per-element hyperparameter tables must be built in that same
+        order — numeric order silently applied the wrong lr/momentum to
+        layers 2+. Distinct per-layer lrs make any misalignment visible."""
+        from deeplearning4j_tpu.parallel import ShardedUpdateTrainer
+
+        x, y = load_iris()
+        x, y = np.asarray(x)[:64], np.asarray(y)[:64]
+        n_layers = 12
+        builder = (NeuralNetConfiguration.builder()
+                   .lr(0.1).n_in(4).activation_function("tanh")
+                   .optimization_algo("iteration_gradient_descent")
+                   .num_iterations(1)
+                   .list(n_layers)
+                   .hidden_layer_sizes([8] * (n_layers - 1))
+                   .override(-1, fn=lambda i, c: setattr(
+                       c, "lr", 0.02 * (1 + i % 5)))
+                   .override(n_layers - 1, layer="output",
+                             loss_function="mcxent",
+                             activation_function="softmax", n_out=3)
+                   .pretrain(False))
+        conf = builder.build()
+        mesh = make_mesh({"data": 8})
+        a, b = MultiLayerNetwork(conf), MultiLayerNetwork(conf)
+        b.set_parameters(np.asarray(a.params()))
+
+        def it():
+            return ListDataSetIterator(DataSet(x, y), batch_size=64)
+
+        DataParallelTrainer(a, mesh).fit(it(), epochs=2)
+        ShardedUpdateTrainer(b, mesh).fit(it(), epochs=2)
+        np.testing.assert_allclose(np.asarray(a.params()),
+                                   np.asarray(b.params()), atol=1e-5)
+
     def test_unit_norm_constraint_rejected(self):
         import pytest
 
