@@ -12,9 +12,13 @@ Backward: the FlashAttention recompute strategy with the saved LSE —
 P = exp(S − lse) is rebuilt tile-by-tile (never materializing the full
 score matrix), D = rowsum(dO ∘ O) precomputed outside. Two kernels:
 dQ iterates KV blocks per Q tile; dK/dV iterates Q tiles per KV block
-(each with the matching causal skip). Measured vs the jax.vjp-of-
-blockwise fallback on v5e at (4×8)×2048×64 bf16 causal: 24.2 vs 28.5
-ms per grad step, gradients equal to bf16 accumulation tolerance.
+(each with the matching causal skip).
+
+All dots run with bf16 operands (f32 accumulation via
+preferred_element_type) — the v5e MXU's native mode; softmax state is
+f32 in base-2 (exp2). Causal masking only runs on diagonal-crossing
+blocks; fully-visible blocks take a mask-free branch. Measured numbers
+and the amortized chained-scan timing protocol: BASELINE.md.
 
 Falls back to `blockwise_attention` (forward AND backward) for
 tile-indivisible shapes; interpret mode covers CPU tests on the same
@@ -32,6 +36,39 @@ from deeplearning4j_tpu.attention.blockwise import blockwise_attention
 
 NEG_INF = -1e30
 LANES = 128  # Mosaic-aligned trailing dim for row vectors (lse, D)
+LOG2E = 1.4426950408889634   # softmax state is kept in base-2 (exp2)
+LN2 = 0.6931471805599453     # converts base-2 LSE back to natural log
+
+
+def _fit_tile(t: int, tile: int):
+    """Largest 128-aligned divisor of t that is <= tile.
+
+    Returns None when no such divisor exists (ragged t — caller falls
+    back to blockwise). This keeps lengths like 768 or 1536 on the
+    kernel with a smaller tile instead of silently demoting them to the
+    fallback when they don't divide the default tile."""
+    for c in range(tile, 0, -128):
+        if c <= t and t % c == 0:
+            return c
+    return None
+
+
+def _causal_branches(causal: bool, qi, ki, q_tile: int, block_k: int,
+                     causal_offset: int):
+    """(visible, diagonal) predicates for one grid step: `visible` =
+    every element of this KV block is on or below the diagonal for every
+    query of the tile (mask-free branch); `diagonal` = the block crosses
+    the diagonal (iota/compare/where masking required). Blocks entirely
+    above the diagonal fire neither branch — the causal skip."""
+    if not causal:
+        return jnp.asarray(True), jnp.asarray(False)
+    skip = ki * block_k > (qi + 1) * q_tile - 1 + causal_offset
+    diagonal = jnp.logical_and(
+        jnp.logical_not(skip),
+        ki * block_k + block_k - 1 > qi * q_tile + causal_offset)
+    visible = jnp.logical_and(jnp.logical_not(skip),
+                              jnp.logical_not(diagonal))
+    return visible, diagonal
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, s_ref, *,
@@ -48,26 +85,32 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, s_ref, *,
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         s_ref[...] = jnp.zeros_like(s_ref)
 
-    # causal skip: this KV block starts after the last key visible to the
-    # tile's last query (bottom-right alignment: query i sees keys up to
-    # i + causal_offset, causal_offset = Tk - Tq — matches blockwise;
-    # fully-masked rows output 0 like blockwise, unlike naive's mean-of-V).
-    if causal:
-        skip = ki * block_k > (qi + 1) * q_tile - 1 + causal_offset
-    else:
-        skip = jnp.asarray(False)
+    # causal semantics: bottom-right alignment — query i sees keys up to
+    # i + causal_offset, causal_offset = Tk - Tq (matches blockwise;
+    # fully-masked rows output 0 like blockwise, unlike naive's
+    # mean-of-V). Blocks entirely BELOW the diagonal take the mask-free
+    # branch: the per-block iota/compare/where VPU work only runs on
+    # diagonal-crossing blocks, and at these tile sizes the VPU softmax
+    # — not the MXU — is the kernel's bottleneck.
+    visible, diagonal = _causal_branches(
+        causal, qi, ki, q_tile, block_k, causal_offset)
 
-    @pl.when(jnp.logical_not(skip))
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)  # (q_tile, d)
-        k = k_ref[0].astype(jnp.float32)  # (block_k, d)
-        v = v_ref[0].astype(jnp.float32)
+    def _tile_update(masked: bool):
+        # operands stay in their storage dtype (bf16): the v5e MXU runs
+        # bf16 matmuls at full rate with f32 accumulation
+        # (preferred_element_type) — casting to f32 first quarters MXU
+        # throughput. Softmax state is f32 throughout, kept in base-2
+        # (scores pre-scaled by log2(e)/sqrt(d), exp2 instead of exp) so
+        # the transcendental is a bare exp2 with no hidden multiply.
+        q = q_ref[0]  # (q_tile, d)
+        k = k_ref[0]  # (block_k, d)
+        v = v_ref[0]
         d = q.shape[-1]
-        scale = 1.0 / jnp.float32(d) ** 0.5
+        scale2 = jnp.float32(LOG2E) / jnp.float32(d) ** 0.5
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
+            preferred_element_type=jnp.float32) * scale2
+        if masked:
             q_pos = qi * q_tile + jax.lax.broadcasted_iota(
                 jnp.int32, (q_tile, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
@@ -76,15 +119,27 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, s_ref, *,
             scores = jnp.where(mask, scores, NEG_INF)
         m_prev, s_prev = m_ref[...], s_ref[...]
         m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(scores - m_new)
-        if causal:
-            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp2(m_prev - m_new)
+        p = jnp.exp2(scores - m_new)
+        if masked:
+            p = jnp.where(mask, p, 0.0)  # fully-masked rows: m_new=NEG_INF
         m_ref[...] = m_new
         s_ref[...] = s_prev * alpha + p.sum(axis=-1, keepdims=True)
+        # P is cast to V's storage dtype for the second MXU dot (standard
+        # flash formulation; accumulation stays f32 so the bf16 rounding
+        # of P costs ~2^-8 relative — inside bf16 output tolerance)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    @pl.when(visible)
+    def _compute_unmasked():
+        _tile_update(masked=False)
+
+    if causal:
+        @pl.when(diagonal)
+        def _compute_masked():
+            _tile_update(masked=True)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -96,8 +151,11 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, s_ref, *,
         # Stored lane-broadcast (q_tile, LANES) — Mosaic block shapes
         # need a 128-divisible trailing dim.
         s = s_ref[...]
+        # m is tracked in base-2 (see _tile_update); convert to the
+        # natural-log LSE the backward kernels expect: ln2·m + ln(s)
         lse = jnp.where(s > 0.0,
-                        m_ref[...] + jnp.log(jnp.maximum(s, 1e-30)),
+                        jnp.float32(LN2) * m_ref[...]
+                        + jnp.log(jnp.maximum(s, 1e-30)),
                         jnp.float32(-NEG_INF))  # (q_tile, 1)
         lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], LANES))
 
@@ -145,27 +203,29 @@ def _flash_forward(q, k, v, causal: bool, q_tile: int, block_k: int,
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal: bool = False, q_tile: int = 256,
-                    block_k: int = 512, interpret: bool = False):
+def flash_attention(q, k, v, causal: bool = False, q_tile: int = 512,
+                    block_k: int = 1024, interpret: bool = False):
     """Pallas flash attention. q/k/v: (batch[*heads], T, d). Tile sizes
-    clamp to T, so short sequences stay on the kernel; T not divisible
-    by the (clamped) tiles falls back to blockwise. Set interpret=True
-    off-TPU.
+    fit to T (largest 128-aligned divisor <= the requested tile), so
+    short or oddly-sized-but-aligned sequences stay on the kernel; T
+    with no 128-aligned divisor falls back to blockwise. Set
+    interpret=True off-TPU.
 
-    Defaults tuned on v5e at (4x8)x2048x64 bf16 causal: 256/512 measured
-    ~1.4x faster than 128/128 (11.3 vs 16.0 ms with hard D2H sync).
+    Defaults tuned on v5e at (4x8)x2048x64 bf16 causal under the
+    amortized chained-scan protocol (see BASELINE.md): 512/1024 at
+    0.54 ms/step vs 0.60 (256/1024) and 0.66 (512/2048); 1024/1024
+    ties within noise but halves grid parallelism for short sequences.
 
     NOTE: sequence length is axis -2 (NOT axis 1 — a 4-D (B, H, T, d)
     input's axis 1 is heads; reading it as T silently routed every 4-D
     call to the blockwise fallback)."""
     t_q, t_k = q.shape[-2], k.shape[-2]
-    # clamp tiles to shorter sequences, but only lane-aligned ones —
-    # ragged lengths go to the blockwise fallback
-    if t_q < q_tile and t_q % 128 == 0:
-        q_tile = t_q
-    if t_k < block_k and t_k % 128 == 0:
-        block_k = t_k
-    if t_q % q_tile or t_k % block_k:
+    # fit tiles: largest 128-aligned divisor <= the requested tile, so
+    # e.g. T=768 runs the kernel at tile 384 instead of falling back;
+    # truly ragged lengths go to the blockwise fallback
+    q_tile = _fit_tile(t_q, q_tile)
+    block_k = _fit_tile(t_k, block_k)
+    if q_tile is None or block_k is None:
         return blockwise_attention(q, k, v, causal=causal)
     out, _lse = _flash_forward(q.reshape(-1, t_q, q.shape[-1]),
                                k.reshape(-1, t_k, k.shape[-1]),
@@ -191,36 +251,47 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    if causal:
-        skip = ki * block_k > (qi + 1) * q_tile - 1 + causal_offset
-    else:
-        skip = jnp.asarray(False)
+    visible, diagonal = _causal_branches(
+        causal, qi, ki, q_tile, block_k, causal_offset)
 
-    @pl.when(jnp.logical_not(skip))
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+    def _tile_update(masked: bool):
+        # bf16 MXU operands with f32 accumulation, like the forward;
+        # P recomputed in base-2 from the saved natural-log LSE. As in
+        # the forward, the iota/compare/where masking only runs on
+        # diagonal-crossing blocks.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0]        # (q_tile,) lane-broadcast store
         dd = dd_ref[0][:, 0]          # (q_tile,) rowsum(dO ∘ O)
         d = q.shape[-1]
         scale = 1.0 / jnp.float32(d) ** 0.5
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * (scale * jnp.float32(LOG2E))
+        if masked:
             q_pos = qi * q_tile + jax.lax.broadcasted_iota(
                 jnp.int32, (q_tile, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (q_tile, block_k), 1)
             s = jnp.where(k_pos <= q_pos + causal_offset, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp2(s - (lse * jnp.float32(LOG2E))[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - dd[:, None])
         dq_acc[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+
+    @pl.when(visible)
+    def _compute_unmasked():
+        _tile_update(masked=False)
+
+    if causal:
+        @pl.when(diagonal)
+        def _compute_masked():
+            _tile_update(masked=True)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -243,42 +314,47 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    if causal:
-        # this Q tile's last query sees keys up to (qi+1)*q_tile-1+offset;
-        # skip when the whole KV block is beyond that for ALL queries of
-        # the tile, i.e. block start > tile's last visible key
-        skip = ki * block_k > (qi + 1) * q_tile - 1 + causal_offset
-    else:
-        skip = jnp.asarray(False)
+    visible, diagonal = _causal_branches(
+        causal, qi, ki, q_tile, block_k, causal_offset)
 
-    @pl.when(jnp.logical_not(skip))
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+    def _tile_update(masked: bool):
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0]
         dd = dd_ref[0][:, 0]
         d = q.shape[-1]
         scale = 1.0 / jnp.float32(d) ** 0.5
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * (scale * jnp.float32(LOG2E))
+        if masked:
             q_pos = qi * q_tile + jax.lax.broadcasted_iota(
                 jnp.int32, (q_tile, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (q_tile, block_k), 1)
             s = jnp.where(k_pos <= q_pos + causal_offset, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])          # (q_tile, block_k)
+        p = jnp.exp2(s - (lse * jnp.float32(LOG2E))[:, None])
+        pb = p.astype(do.dtype)                      # (q_tile, block_k)
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            pb, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - dd[:, None])
         dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+
+    @pl.when(visible)
+    def _compute_unmasked():
+        _tile_update(masked=False)
+
+    if causal:
+        @pl.when(diagonal)
+        def _compute_masked():
+            _tile_update(masked=True)
 
     @pl.when(qi == n_q - 1)
     def _finalize():
@@ -353,12 +429,9 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, q_tile: int,
 
 def _fwd(q, k, v, causal, q_tile, block_k, interpret):
     t_q, t_k = q.shape[-2], k.shape[-2]
-    qt, bk = q_tile, block_k
-    if t_q < qt and t_q % 128 == 0:
-        qt = t_q
-    if t_k < bk and t_k % 128 == 0:
-        bk = t_k
-    if t_q % qt or t_k % bk:
+    qt = _fit_tile(t_q, q_tile)
+    bk = _fit_tile(t_k, block_k)
+    if qt is None or bk is None:
         # ragged: forward used the blockwise fallback — backward must too
         out = blockwise_attention(q, k, v, causal=causal)
         return out, (q, k, v, None, None)
@@ -379,11 +452,8 @@ def _bwd(causal, q_tile, block_k, interpret, res, g):
             q, k, v)
         return vjp(g)
     t_q, t_k = q.shape[-2], k.shape[-2]
-    qt, bk = q_tile, block_k
-    if t_q < qt and t_q % 128 == 0:
-        qt = t_q
-    if t_k < bk and t_k % 128 == 0:
-        bk = t_k
+    qt = _fit_tile(t_q, q_tile)
+    bk = _fit_tile(t_k, block_k)
     dq, dk, dv = _flash_backward(
         q.reshape(-1, t_q, q.shape[-1]), k.reshape(-1, t_k, k.shape[-1]),
         v.reshape(-1, t_k, v.shape[-1]), out3,

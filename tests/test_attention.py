@@ -273,6 +273,39 @@ class TestSelfAttentionLayer:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5)
 
+    def test_non_divisor_aligned_lengths_stay_on_kernel(self, monkeypatch):
+        """T=768 doesn't divide the default 512/1024 tiles but has the
+        128-aligned divisor 384 — tile fitting must keep it on the
+        kernel instead of silently demoting it to the blockwise
+        fallback (the old clamp only fired for T < tile)."""
+        import deeplearning4j_tpu.attention.flash_pallas as fp
+
+        calls = {"n": 0}
+        real = fp._flash_forward
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return real(*a, **kw)
+
+        monkeypatch.setattr(fp, "_flash_forward", counting)
+        q, k, v = qkv(b=2, t=768, d=16)
+        ref = blockwise_attention(q, k, v, causal=True)
+        out = fp.flash_attention(q, k, v, causal=True, interpret=True)
+        assert calls["n"] == 1, "768-length input fell back"
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_fit_tile(self):
+        from deeplearning4j_tpu.attention.flash_pallas import _fit_tile
+
+        assert _fit_tile(2048, 512) == 512
+        assert _fit_tile(768, 512) == 384
+        assert _fit_tile(1536, 1024) == 768
+        assert _fit_tile(256, 512) == 256
+        assert _fit_tile(128, 512) == 128
+        assert _fit_tile(60, 512) is None    # ragged -> fallback
+        assert _fit_tile(640, 512) == 128    # 640 = 5*128
+
     @pytest.mark.parametrize("causal", [False, True])
     @pytest.mark.parametrize("tq,tk", [(128, 256), (256, 128)])
     def test_pallas_backward_cross_shapes(self, causal, tq, tk):

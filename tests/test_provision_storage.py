@@ -144,7 +144,11 @@ class TestProvisioning:
         assert any("--worker-id w0" in r for r in recorded)
         assert any("--worker-id w1" in r for r in recorded)
 
-    def test_setup_script_failure_isolated_per_host(self, tmp_path):
+    def test_setup_script_failure_isolated_per_host(self, tmp_path,
+                                                    monkeypatch):
+        # upload_and_run stages the script into the transport's working
+        # dir (default "."), so isolate cwd or the copy lands in the repo
+        monkeypatch.chdir(tmp_path)
         bad = tmp_path / "bad.sh"
         bad.write_text("exit 3\n")
         cs = ClusterSetup({"w0": LocalTransport()},
