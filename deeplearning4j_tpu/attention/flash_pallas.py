@@ -47,7 +47,7 @@ def _fit_tile(t: int, tile: int):
     back to blockwise). This keeps lengths like 768 or 1536 on the
     kernel with a smaller tile instead of silently demoting them to the
     fallback when they don't divide the default tile."""
-    for c in range(tile, 0, -128):
+    for c in range(tile - tile % 128, 0, -128):
         if c <= t and t % c == 0:
             return c
     return None

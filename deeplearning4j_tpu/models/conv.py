@@ -5,12 +5,16 @@ Parity: reference core/nn/layers/convolution/ConvolutionDownSampleLayer.java:52-
 activation) with params named by ConvolutionParamInitializer
 ("convweights"/"convbias", core/nn/params/ConvolutionParamInitializer.java:33-44).
 
-TPU-native design: NHWC layout with HWIO filters so XLA tiles the conv onto
-the MXU (channels on lanes); `lax.reduce_window` for the max-pool; and —
-unlike the reference, whose `gradient()` returns null (conv training was
-incomplete, ConvolutionDownSampleLayer.java:95) — the layer is fully
-trainable end-to-end via autodiff. The conv runs in conf.compute_dtype
-(bfloat16 on the MXU when configured) accumulating in float32.
+TPU-native design: NHWC layout with HWIO filters, the conv expressed as
+patch-stack + one MXU dot (channels on lanes) and the max-pool as
+crop/reshape/max — both chosen so forward AND backward lower to
+slice/dot/select programs that the TPU toolchain compiles in seconds
+(conv_general_dilated's and reduce_window's transposes each took minutes
+here). Unlike the reference, whose `gradient()` returns null (conv
+training was incomplete, ConvolutionDownSampleLayer.java:95), the layer
+is fully trainable end-to-end via autodiff. The conv runs in
+conf.compute_dtype (bfloat16 on the MXU when configured) accumulating in
+float32.
 """
 
 from __future__ import annotations
@@ -76,21 +80,35 @@ class ConvolutionDownSampleLayer(BaseLayer):
             raise ValueError(
                 f"Filter {fh}x{fw} larger than input {x.shape[1]}x{x.shape[2]}")
         cd = jnp.dtype(c.compute_dtype)
-        # No preferred_element_type: an f32 output from bf16 primals makes
-        # the autodiff transpose feed an f32 cotangent into a bf16 conv
-        # (dtype error); casting after keeps forward AND backward convs
-        # uniformly in compute_dtype (TPU still accumulates bf16 in f32)
-        conv = lax.conv_general_dilated(
-            x.astype(cd), params["W"].astype(cd),
-            window_strides=(1, 1), padding="VALID",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        ).astype(jnp.dtype(c.dtype))
+        # Stride-1 VALID conv as patch-stack + matmul: fh*fw shifted
+        # slices concatenated on the channel axis, then one dot onto the
+        # flattened HWIO filter. Identical math to conv_general_dilated
+        # (slice order (dh*fw + dw)*C_in + ci matches the C-order filter
+        # reshape), but lowers to slices + a single MXU dot whose
+        # gradient is pad+add + two dots — conv_general_dilated's
+        # backward takes minutes to compile on the TPU toolchain here,
+        # vs seconds for this form. bf16 operands, f32 accumulation.
+        xin = x.astype(cd)
+        oh = x.shape[1] - fh + 1
+        ow = x.shape[2] - fw + 1
+        patches = jnp.concatenate(
+            [xin[:, dh:dh + oh, dw:dw + ow, :]
+             for dh in range(fh) for dw in range(fw)], axis=-1)
+        w_flat = params["W"].astype(cd).reshape(-1, c.num_feature_maps)
+        conv = jax.lax.dot_general(
+            patches, w_flat, (((3,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(jnp.dtype(c.dtype))
         ph, pw = self._pool_hw()
-        pooled = lax.reduce_window(
-            conv, -jnp.inf, lax.max,
-            window_dimensions=(1, ph, pw, 1),
-            window_strides=(1, ph, pw, 1),
-            padding="VALID")
+        # window == stride (reference Transforms.maxPool semantics), so
+        # pooling is a crop + reshape + max — equivalent to
+        # reduce_window(VALID) but WITHOUT its select-and-scatter
+        # gradient, whose TPU compile is pathological (~80 s per conv
+        # layer vs ~2 s for the reshape formulation's compare/select)
+        hh = conv.shape[1] // ph * ph
+        ww = conv.shape[2] // pw * pw
+        pooled = conv[:, :hh, :ww, :].reshape(
+            conv.shape[0], hh // ph, ph, ww // pw, pw,
+            conv.shape[3]).max(axis=(2, 4))
         act = apply_activation(c.activation_function, pooled + params["b"])
         return apply_dropout(rng, act, c.dropout, training)
 

@@ -104,27 +104,19 @@ class Glove(WordVectors):
             self.sentence_iter = None
         self.vocab = VocabCache()
         self.co: Optional[CoOccurrences] = None
+        self._epoch_fn = None
+        self._params = None
+        self._accum = None
+        self._triples = None
+        self._rng = None
 
-    def fit(self) -> "Glove":
-        build_vocab(self.sentence_iter, self.tokenizer_factory,
-                    self.min_word_frequency, self.vocab)
-        self.co = CoOccurrences(self.sentence_iter, self.tokenizer_factory,
-                                self.vocab, window=self.window).calc()
-        rows, cols, vals = self.co.triples()
-        if rows.size == 0:
-            raise ValueError("No co-occurrences (corpus too small)")
-        v, d = self.vocab.num_words(), self.layer_size
-        key = jax.random.PRNGKey(self.seed)
-        kw, kc = jax.random.split(key)
-        params = {
-            "w": jax.random.uniform(kw, (v, d), jnp.float32, -0.5 / d, 0.5 / d),
-            "c": jax.random.uniform(kc, (v, d), jnp.float32, -0.5 / d, 0.5 / d),
-            "bw": jnp.zeros((v,), jnp.float32),
-            "bc": jnp.zeros((v,), jnp.float32),
-        }
-        # per-parameter AdaGrad accumulators (GloveWeightLookupTable parity)
-        accum = jax.tree_util.tree_map(
-            lambda p: jnp.full(p.shape, 1e-8, jnp.float32), params)
+    def _epoch_step(self):
+        """Build (once) the compiled whole-epoch program: per-batch host
+        dispatch (the dominant cost on a tunneled chip) is paid once per
+        epoch, and the triple count is fixed so every epoch — across
+        repeated train_epochs calls — reuses the same program."""
+        if self._epoch_fn is not None:
+            return self._epoch_fn
         x_max, alpha, lr = self.x_max, self.alpha, self.lr
 
         def loss_fn(params, r, c, x):
@@ -145,35 +137,78 @@ class Glove(WordVectors):
                 accum)
             return (params, accum), loss
 
-        # whole shuffled epoch as ONE scan program: per-batch host
-        # dispatch (the dominant cost on a tunneled chip) is paid once
-        # per epoch; the triple count is fixed, so every epoch reuses the
-        # same compiled program
         @jax.jit
         def epoch(params, accum, rb, cb, xb):
             (params, accum), losses = jax.lax.scan(
                 step_core, (params, accum), (rb, cb, xb))
             return params, accum, losses[-1]
 
-        rng = np.random.RandomState(self.seed)
+        self._epoch_fn = epoch
+        return epoch
+
+    def prepare(self) -> "Glove":
+        """Corpus pass: vocab + co-occurrence counting (reference
+        Glove.java :106 CoOccurrences.calc) and parameter init. Split
+        from training so repeated train_epochs calls (resumed training,
+        benchmarks) don't re-mine the corpus."""
+        build_vocab(self.sentence_iter, self.tokenizer_factory,
+                    self.min_word_frequency, self.vocab)
+        self.co = CoOccurrences(self.sentence_iter, self.tokenizer_factory,
+                                self.vocab, window=self.window).calc()
+        rows, cols, vals = self.co.triples()
+        if rows.size == 0:
+            raise ValueError("No co-occurrences (corpus too small)")
+        self._triples = (rows, cols, vals)
+        v, d = self.vocab.num_words(), self.layer_size
+        key = jax.random.PRNGKey(self.seed)
+        kw, kc = jax.random.split(key)
+        self._params = {
+            "w": jax.random.uniform(kw, (v, d), jnp.float32, -0.5 / d, 0.5 / d),
+            "c": jax.random.uniform(kc, (v, d), jnp.float32, -0.5 / d, 0.5 / d),
+            "bw": jnp.zeros((v,), jnp.float32),
+            "bc": jnp.zeros((v,), jnp.float32),
+        }
+        # per-parameter AdaGrad accumulators (GloveWeightLookupTable parity)
+        self._accum = jax.tree_util.tree_map(
+            lambda p: jnp.full(p.shape, 1e-8, jnp.float32), self._params)
+        self._rng = np.random.RandomState(self.seed)
+        return self
+
+    def train_epochs(self, n_epochs: int) -> float:
+        """Run n shuffled epochs over the prepared co-occurrence triples
+        (one compiled program per epoch) and refresh the WordVectors
+        view. Returns the final batch loss."""
+        if self._triples is None:
+            raise ValueError("call prepare() before train_epochs()")
+        if n_epochs < 1:
+            raise ValueError(f"n_epochs must be >= 1, got {n_epochs}")
+        rows, cols, vals = self._triples
+        epoch = self._epoch_step()
         n = rows.size
         B = self.batch_size
         # pad the shuffled order up to a batch multiple (same tiling the
         # per-batch path used for its final partial batch)
         n_pad = (n + B - 1) // B * B
         loss = None
-        for _ in range(self.iterations):
-            order = rng.permutation(n)
+        for _ in range(n_epochs):
+            order = self._rng.permutation(n)
             if n_pad != n:
                 order = np.concatenate(
                     [order, order[np.arange(n_pad - n) % n]])
             shape = (n_pad // B, B)
-            params, accum, loss = epoch(
-                params, accum,
+            self._params, self._accum, loss = epoch(
+                self._params, self._accum,
                 jnp.asarray(rows[order].reshape(shape)),
                 jnp.asarray(cols[order].reshape(shape)),
                 jnp.asarray(vals[order].reshape(shape)))
-        log.info("glove trained: %d triples, final loss %.4f", n, float(loss))
-        syn0 = np.asarray(params["w"]) + np.asarray(params["c"])
+        syn0 = (np.asarray(self._params["w"])
+                + np.asarray(self._params["c"]))
         WordVectors.__init__(self, self.vocab, syn0)
+        return float(loss)
+
+    def fit(self) -> "Glove":
+        self.prepare()
+        loss = self.train_epochs(self.iterations)
+        log.info("glove trained: %d triples, final loss %.4f",
+                 self._triples[0].size, loss)
         return self

@@ -242,13 +242,16 @@ class MultiLayerNetwork:
         dispatch. Beyond-parity alternative path for the
         iteration_gradient_descent algorithm.
 
-        Use when host dispatch dominates: many tiny steps, slow host, or
-        driving the device from a high-latency link. For large-matmul
-        configs on a local chip prefer `fit()` — the dispatched per-step
-        program reaches near-peak MXU utilization that XLA does not
-        currently match inside a scan body (measured ~15x per-step gap on
-        v5e for the 784-2048-1024-10 bench config), and `epochs` is a
-        static arg (each distinct value compiles its own program).
+        This is the preferred training path whenever per-step host
+        dispatch costs anything (it always does through a tunneled
+        chip): under the honest D2H-synced protocol the 784-2048-1024-10
+        bench config measures ~2.2 ms/step inside the scan vs ~20 ms per
+        dispatched `fit()` step on tunneled v5e. (An earlier note here
+        claimed the opposite by ~15x — that measurement trusted
+        `block_until_ready`, which on the tunnel returns before the
+        dispatched work completes; see BASELINE.md "timing protocol".)
+        Caveat: `epochs` is a static arg — each distinct value compiles
+        its own program.
 
         `x`: (N, features); N is truncated to a multiple of batch_size.
         Returns the final batch's score."""

@@ -305,6 +305,7 @@ class TestSelfAttentionLayer:
         assert _fit_tile(128, 512) == 128
         assert _fit_tile(60, 512) is None    # ragged -> fallback
         assert _fit_tile(640, 512) == 128    # 640 = 5*128
+        assert _fit_tile(256, 300) == 256    # non-128-multiple tile arg
 
     @pytest.mark.parametrize("causal", [False, True])
     @pytest.mark.parametrize("tq,tk", [(128, 256), (256, 128)])
