@@ -71,6 +71,7 @@ class MultiProcessMaster(DistributedRuntime):
                  host: str = "127.0.0.1", port: int = 0,
                  conf_json: Optional[str] = None,
                  work_dir: Optional[str] = None,
+                 status_port: Optional[int] = None,
                  **kw):
         if work_dir is not None:
             from deeplearning4j_tpu.scaleout.api import LocalWorkRetriever
@@ -82,12 +83,23 @@ class MultiProcessMaster(DistributedRuntime):
         self.registry = registry
         self.server = StateTrackerServer(self.tracker, host=host, port=port)
         self.server.start()
+        # live status endpoint (reference: Dropwizard UI embedded in the
+        # Hazelcast tracker, BaseHazelCastStateTracker.java:181-189).
+        # status_port=0 picks an ephemeral port; None disables.
+        self.status_server = None
+        if status_port is not None:
+            from deeplearning4j_tpu.scaleout.status import StatusServer
+            self.status_server = StatusServer(
+                self.tracker, runtime=self, host=host,
+                port=status_port).start()
         run_conf = {
             TRACKER_ADDRESS: self.server.address,
             PERFORMER_CLASS: performer_class,
             PERFORMER_CONF: performer_conf or {},
             "n_workers": n_workers,
         }
+        if self.status_server is not None:
+            run_conf["status_address"] = self.status_server.address
         if work_dir is not None:
             run_conf[WORK_DIR] = work_dir
         registry.register_run(run_name, run_conf)
@@ -100,6 +112,8 @@ class MultiProcessMaster(DistributedRuntime):
             return super().run(timeout=timeout)
         finally:
             self.server.stop()
+            if self.status_server is not None:
+                self.status_server.stop()
             self.registry.unregister_run(self.run_name)
 
 
@@ -159,6 +173,10 @@ def main(argv=None) -> int:
     p.add_argument("--run", required=True, help="run name to join")
     p.add_argument("--worker-id", required=True)
     p.add_argument("--heartbeat-interval", type=float, default=0.01)
+    p.add_argument("--registration-timeout", type=float, default=30.0,
+                   help="seconds to wait for the run to appear in the "
+                        "registry (raise for later-phase runs, e.g. the "
+                        "train phase behind a distributed vocab build)")
     p.add_argument("--jax-coordinator", default=None,
                    help="host:port for jax.distributed.initialize "
                         "(multi-host pods)")
@@ -169,7 +187,8 @@ def main(argv=None) -> int:
     _maybe_init_jax_distributed(args)
     performed = run_worker(registry_root=args.registry, run_name=args.run,
                            worker_id=args.worker_id,
-                           heartbeat_interval=args.heartbeat_interval)
+                           heartbeat_interval=args.heartbeat_interval,
+                           registration_timeout=args.registration_timeout)
     log.info("worker %s done: %d jobs", args.worker_id, performed)
     return 0
 

@@ -42,7 +42,7 @@ ALLOWED_METHODS = frozenset({
     "stale_workers", "add_job", "job_for", "clear_job", "jobs",
     "add_update", "worker_updates", "load_update", "clear_update",
     "clear_updates", "set_current", "get_current", "needs_replicate",
-    "done_replicating", "increment", "count", "define", "get",
+    "done_replicating", "increment", "count", "counters", "define", "get",
     "set_patience", "patience", "report_loss", "best_loss", "early_stop",
     "input_split", "batch_size", "finish", "is_done",
 })

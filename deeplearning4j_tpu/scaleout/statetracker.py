@@ -180,6 +180,11 @@ class InMemoryStateTracker:
         with self._lock:
             return self._counters.get(key, 0.0)
 
+    def counters(self) -> Dict[str, float]:
+        """All counters at once (status/observability surface)."""
+        with self._lock:
+            return dict(self._counters)
+
     def define(self, key: str, value: Any) -> None:
         with self._lock:
             self._kv[key] = value

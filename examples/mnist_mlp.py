@@ -1,8 +1,13 @@
 """MNIST 3-layer MLP — the reference MultiLayerTest end-to-end slice.
 
-Run: python examples/mnist_mlp.py  (set JAX_PLATFORMS=cpu to force CPU)
+Run: python examples/mnist_mlp.py  (set JAX_PLATFORMS=cpu to force CPU;
+DL4J_TPU_EXAMPLE_FAST=1 shrinks the run for CI smoke)
 """
+import os
+
 import numpy as np
+
+FAST = os.environ.get("DL4J_TPU_EXAMPLE_FAST") == "1"
 
 from deeplearning4j_tpu.config import NeuralNetConfiguration
 from deeplearning4j_tpu.datasets.mnist import synthetic_mnist
@@ -24,12 +29,12 @@ conf = (NeuralNetConfiguration.builder()
 net = MultiLayerNetwork(conf)
 net.set_listeners([ScoreIterationListener(10)])
 
-x, y = synthetic_mnist(8192)  # swap in load_mnist(...) for the real IDX files
+x, y = synthetic_mnist(2048 if FAST else 8192)  # or load_mnist(...) for real IDX
 from deeplearning4j_tpu.datasets import ListDataSetIterator
 from deeplearning4j_tpu.datasets.api import DataSet
 
 net.fit(ListDataSetIterator(DataSet(np.asarray(x), np.asarray(y)),
-                            batch_size=512), epochs=3)
+                            batch_size=512), epochs=1 if FAST else 3)
 
 ev = Evaluation()
 ev.eval(np.asarray(y), np.asarray(net.output(x)))

@@ -24,6 +24,30 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: opt-in real-chip lane — runs only under "
+        "DL4J_TPU_TEST_PLATFORM=axon pytest -m tpu (README 'Testing')")
+
+
+def pytest_collection_modifyitems(config, items):
+    on_real_chip = os.environ.get("DL4J_TPU_TEST_PLATFORM", "cpu") != "cpu"
+    skip_tpu = pytest.mark.skip(
+        reason="real-chip lane: set DL4J_TPU_TEST_PLATFORM=axon")
+    skip_cpu_only = pytest.mark.skip(
+        reason="CPU-tier test skipped on the real-chip lane (run the "
+        "default suite for these)")
+    for item in items:
+        if "tpu" in item.keywords:
+            if not on_real_chip:
+                item.add_marker(skip_tpu)
+        elif on_real_chip:
+            # the real-chip lane runs ONLY @tpu tests: the CPU tiers pin
+            # jax to cpu per-process state these tests would fight
+            item.add_marker(skip_cpu_only)
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.RandomState(0)

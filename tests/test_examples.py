@@ -1,0 +1,37 @@
+"""Examples smoke tests: every script in examples/ must run green on CPU
+(the public face of the framework should never rot). Each runs as a real
+subprocess the way a user would invoke it."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.test_multiprocess import REPO_ROOT
+
+EXAMPLES = {
+    "mnist_mlp.py": "F1",                 # prints Evaluation.stats()
+    "dbn_pretrain.py": None,
+    "word2vec_text.py": None,
+    "long_context.py": "max err",
+    "distributed_dp.py": "waves",
+}
+
+
+@pytest.mark.parametrize("script,marker", sorted(EXAMPLES.items()))
+def test_example_runs_green(script, marker):
+    env = dict(os.environ,
+               PYTHONPATH=REPO_ROOT + os.pathsep
+               + os.environ.get("PYTHONPATH", ""),
+               JAX_PLATFORMS="cpu",
+               DL4J_TPU_EXAMPLE_FAST="1",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "examples", script)],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (
+        f"{script} failed:\n{proc.stdout}\n{proc.stderr}")
+    if marker is not None:
+        assert marker in proc.stdout, (
+            f"{script} output missing {marker!r}:\n{proc.stdout}")

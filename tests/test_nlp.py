@@ -156,6 +156,14 @@ class TestGlove:
         ia, ib = cache.index_of("a"), cache.index_of("b")
         assert co.counts[(ia, ib)] == 2.0  # adjacent twice, 1/1 weight
         assert co.counts[(ib, ia)] == 2.0  # symmetric
+        # cross-sentence-boundary regression: the separator must never
+        # leak into pairs ("c"→next sentence's "a"/"b" at offset <= 2),
+        # and (a, c) keeps its single within-sentence 1/2 weight
+        ic = cache.index_of("c")
+        assert co.counts[(ia, ic)] == 0.5
+        assert co.counts[(ic, ia)] == 0.5
+        rows, cols, _ = co.triples()
+        assert (rows >= 0).all() and (cols >= 0).all()
 
     def test_glove_learns_topic_structure(self):
         """Two word pools with heavy within-pool co-occurrence — the

@@ -1,0 +1,147 @@
+"""Opt-in real-chip test lane (SURVEY §4 tier (c) on actual hardware).
+
+Run:  DL4J_TPU_TEST_PLATFORM=axon python -m pytest tests/ -m tpu -q
+
+Everything here executes on the real TPU behind the axon tunnel: the
+Pallas kernels compile for Mosaic (interpret=False), bf16 runs on the
+MXU, and buffer donation exercises the real allocator. The default CPU
+suite skips these (see conftest.pytest_collection_modifyitems); the lane
+conversely runs ONLY these. Budget: the whole lane must stay under ~2
+minutes including compiles."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.devices()[0].platform == "tpu", (
+        "tpu lane launched without a real chip")
+    B, H, S, D = 2, 4, 512, 64
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    return (jax.random.normal(kq, (B, H, S, D), jnp.bfloat16),
+            jax.random.normal(kk, (B, H, S, D), jnp.bfloat16),
+            jax.random.normal(kv, (B, H, S, D), jnp.bfloat16))
+
+
+class TestFlashKernelOnChip:
+    def test_forward_kernel_engages_and_matches(self, qkv, monkeypatch):
+        """The compiled Pallas kernel (not the blockwise fallback) must
+        run, and agree with blockwise to bf16 tolerance."""
+        import jax
+        import jax.numpy as jnp
+
+        import deeplearning4j_tpu.attention.flash_pallas as fp
+        from deeplearning4j_tpu.attention.blockwise import blockwise_attention
+
+        calls = {"n": 0}
+        real = fp._flash_forward
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            assert a[-1] is False or kw.get("interpret") is False
+            return real(*a, **kw)
+
+        monkeypatch.setattr(fp, "_flash_forward", counting)
+        q, k, v = qkv
+        out = jax.jit(lambda q, k, v: fp.flash_attention(
+            q, k, v, causal=True))(q, k, v)
+        np.asarray(jax.device_get(out.ravel()[:1]))  # force completion
+        assert calls["n"] == 1, "fell back to blockwise on the chip"
+        ref = blockwise_attention(q, k, v, causal=True)
+        err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                    - ref.astype(jnp.float32))))
+        assert err < 0.05, f"kernel vs blockwise err {err}"
+
+    def test_backward_kernels_engage_and_match(self, qkv, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        import deeplearning4j_tpu.attention.flash_pallas as fp
+        from deeplearning4j_tpu.attention.blockwise import blockwise_attention
+
+        calls = {"n": 0}
+        real = fp._flash_backward
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return real(*a, **kw)
+
+        monkeypatch.setattr(fp, "_flash_backward", counting)
+        q, k, v = qkv
+
+        def loss_f(q, k, v):
+            return jnp.sum(fp.flash_attention(
+                q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+        def loss_r(q, k, v):
+            return jnp.sum(blockwise_attention(
+                q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+        gf = jax.jit(jax.grad(loss_f, argnums=(0, 1, 2)))(q, k, v)
+        np.asarray(jax.device_get(gf[0].ravel()[:1]))
+        assert calls["n"] == 1, "backward fell back to vjp-of-blockwise"
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("dq dk dv".split(), gf, gr):
+            scale = float(jnp.max(jnp.abs(b.astype(jnp.float32)))) or 1.0
+            err = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                        - b.astype(jnp.float32))))
+            assert err / scale < 0.02, f"{name} err {err} (scale {scale})"
+
+
+class TestTrainingOnChip:
+    def _net(self):
+        from deeplearning4j_tpu.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (NeuralNetConfiguration.builder()
+                .lr(0.05).n_in(784).activation_function("relu")
+                .optimization_algo("iteration_gradient_descent")
+                .num_iterations(1).batch_size(256)
+                .compute_dtype("bfloat16")
+                .list(3).hidden_layer_sizes([256, 128])
+                .override(2, layer="output", loss_function="mcxent",
+                          activation_function="softmax", n_out=10)
+                .pretrain(False).build())
+        return MultiLayerNetwork(conf)
+
+    def test_donated_train_step_bf16(self):
+        """fit_scan donates (params, updater state); two consecutive
+        calls must work (donated buffers really were consumed) and the
+        score must improve on a learnable batch."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.datasets.mnist import synthetic_mnist
+
+        net = self._net()
+        x_np, y_np = synthetic_mnist(1024)
+        x, y = jnp.asarray(x_np), jnp.asarray(y_np)
+        first = net.fit_scan(x, y, batch_size=256, epochs=2)
+        second = net.fit_scan(x, y, batch_size=256, epochs=2)
+        np.asarray(jax.device_get(net.params().ravel()[:1]))
+        assert np.isfinite(first) and np.isfinite(second)
+        assert second < first, (first, second)
+
+    def test_bf16_eval_on_chip(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.datasets.mnist import synthetic_mnist
+        from deeplearning4j_tpu.eval import Evaluation
+
+        net = self._net()
+        x_np, y_np = synthetic_mnist(512)
+        x, y = jnp.asarray(x_np), jnp.asarray(y_np)
+        net.fit_scan(x, y, batch_size=256, epochs=4)
+        out = np.asarray(jax.device_get(net.output(x)))
+        assert np.isfinite(out).all()
+        ev = Evaluation()
+        ev.eval(np.asarray(y_np), out)
+        assert 0.0 <= ev.f1() <= 1.0
+        assert ev.accuracy() > 0.2  # learned something on-chip
