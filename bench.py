@@ -245,52 +245,29 @@ def bench_word2vec():
     training runs the production chunked-scan step. Reference path:
     nlp/models/word2vec/Word2Vec.java:101,
     InMemoryLookupTable.java:188."""
-    import jax
-    import jax.numpy as jnp
-
     from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 
     n_tokens = 20_000 if _fast() else 200_000
     w2v = Word2Vec(_zipf_sentences(n_tokens, 2000), layer_size=128,
                    window=5, min_word_frequency=1, negative=5,
                    iterations=1, seed=0)
-    w2v.build_vocab()
-    w2v.reset_weights()
-
     t0 = time.perf_counter()
-    chunks = list(w2v._iter_pair_chunks(np.random.RandomState(1)))
+    centers, contexts = w2v.mine_pairs(np.random.RandomState(1))
     mine_s = time.perf_counter() - t0
-    centers = np.concatenate([c for c, _, _ in chunks])
-    contexts = np.concatenate([x for _, x, _ in chunks])
     B, CB = w2v.batch_pairs, w2v.chunk_batches
-    n = centers.size // (B * CB) * (B * CB)
-    if n == 0:  # tiny corpus: tile up to one chunk
+    if centers.size < B * CB:  # tiny corpus: tile up to one chunk
         reps = (B * CB) // centers.size + 1
         centers = np.tile(centers, reps)[:B * CB]
         contexts = np.tile(contexts, reps)[:B * CB]
-        n = B * CB
-    cb = jnp.asarray(centers[:n].reshape(-1, CB, B))
-    xb = jnp.asarray(contexts[:n].reshape(-1, CB, B))
+    n = centers.size // (B * CB) * (B * CB)
+    centers, contexts = centers[:n], contexts[:n]
 
-    _, step_chunk = w2v._build_step()
-    tables = {"syn0": w2v.syn0}
-    if w2v.syn1 is not None:
-        tables["syn1"] = w2v.syn1
-    if w2v.syn1neg is not None:
-        tables["syn1neg"] = w2v.syn1neg
-
-    key = jax.random.PRNGKey(0)
-    tables, _ = step_chunk(tables, cb[0], xb[0], jnp.float32(0.025),
-                           key)  # compile
-    _d2h(tables["syn0"])
+    w2v.train_pairs(centers[:B * CB], contexts[:B * CB])  # compile
+    _d2h(w2v.syn0)
 
     def window():
-        nonlocal tables, key
-        for i in range(cb.shape[0]):
-            key, sub = jax.random.split(key)
-            tables, _ = step_chunk(tables, cb[i], xb[i],
-                                   jnp.float32(0.025), sub)
-        _d2h(tables["syn0"])
+        w2v.train_pairs(centers, contexts)
+        _d2h(w2v.syn0)
 
     rate, win_s = _median_rate(window, n)
     return {"value": round(rate, 2), "unit": "pairs/sec",

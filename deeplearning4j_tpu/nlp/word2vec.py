@@ -412,6 +412,86 @@ class Word2Vec(WordVectors):
 
         return step, step_chunk
 
+    # ---------------------------------------------------- pre-mined pairs
+    def mine_pairs(self, rng=None):
+        """Mine every (center, context) skip-gram pair for ONE corpus
+        pass, as two int32 arrays. Public surface over the chunk miner
+        for callers that reuse pairs across repeated training (resumed
+        runs, benchmarks) instead of re-mining per fit()."""
+        if self.vocab.num_words() == 0:
+            self.build_vocab()
+        rng = rng or np.random.RandomState(self.seed + 1)
+        chunks = list(self._iter_pair_chunks(rng))
+        if not chunks:
+            return np.empty(0, np.int32), np.empty(0, np.int32)
+        centers = np.concatenate([c for c, _, _ in chunks])
+        contexts = np.concatenate([x for _, x, _ in chunks])
+        return centers, contexts
+
+    def train_pairs(self, centers, contexts, alpha: float = None) -> int:
+        """Train on pre-mined pairs through the production chunked-scan
+        step at a FIXED learning rate (callers own any decay schedule).
+        Truncates to whole chunks (chunk_batches x batch_pairs) unless
+        the input is smaller than one batch, which is tiled up. Returns
+        the number of pairs trained."""
+        if self.syn0 is None:
+            self.reset_weights()
+        if self._step_cache is None:
+            self._step_cache = self._build_step()
+        step, step_chunk = self._step_cache
+        alpha = self.alpha if alpha is None else float(alpha)
+        tables = {"syn0": self.syn0}
+        if self.syn1 is not None:
+            tables["syn1"] = self.syn1
+        if self.syn1neg is not None:
+            tables["syn1neg"] = self.syn1neg
+        centers = np.asarray(centers, np.int32)
+        contexts = np.asarray(contexts, np.int32)
+        B, CB = self.batch_pairs, self.chunk_batches
+        n = centers.size // (B * CB) * (B * CB)
+        trained = 0
+        if n:
+            cb = centers[:n].reshape(-1, CB, B)
+            xb = contexts[:n].reshape(-1, CB, B)
+            for i in range(cb.shape[0]):
+                self._key, k = jax.random.split(self._key)
+                tables, _ = step_chunk(tables, jnp.asarray(cb[i]),
+                                       jnp.asarray(xb[i]),
+                                       jnp.float32(alpha), k)
+            trained = n
+        tail_c, tail_x = centers[n:], contexts[n:]
+        for lo in range(0, tail_c.size // B * B, B):
+            self._key, k = jax.random.split(self._key)
+            tables, _ = step(tables, jnp.asarray(tail_c[lo:lo + B]),
+                             jnp.asarray(tail_x[lo:lo + B]),
+                             jnp.float32(alpha), k)
+            trained += B
+        rem = tail_c.size % B
+        if rem and trained == 0:
+            # smaller than one batch: tile up so tiny inputs still train
+            pad = np.arange(B - rem) % rem
+            self._key, k = jax.random.split(self._key)
+            tables, _ = step(
+                tables, jnp.asarray(np.concatenate([tail_c[-rem:],
+                                                    tail_c[-rem:][pad]])),
+                jnp.asarray(np.concatenate([tail_x[-rem:],
+                                            tail_x[-rem:][pad]])),
+                jnp.float32(alpha), k)
+            trained = rem
+        self.syn0 = tables["syn0"]
+        self.syn1 = tables.get("syn1")
+        self.syn1neg = tables.get("syn1neg")
+        self.pairs_trained += trained
+        # NOTE: the similarity/nearest-words view is NOT refreshed here
+        # (that would D2H the whole table every call — train_pairs is
+        # built for tight loops); call refresh_vectors() when done.
+        return trained
+
+    def refresh_vectors(self) -> None:
+        """Pull syn0 to host and refresh the WordVectors view (after a
+        train_pairs loop; fit() does this automatically)."""
+        WordVectors.__init__(self, self.vocab, np.asarray(self.syn0))
+
     def fit(self) -> "Word2Vec":
         """reference fit :101: build vocab, Huffman, reset weights, train
         with lr decaying by words seen (Word2Vec.java :191-296's

@@ -123,6 +123,38 @@ class TestWord2Vec:
         names = [w for w, _ in w2v.words_nearest("cat", n=5)]
         assert "cat" not in names and len(names) == 5
 
+    def test_mine_pairs_train_pairs_public_surface(self):
+        """Pre-mined-pairs training (resume/bench surface): mining once
+        and looping train_pairs learns the same topic structure fit()
+        does, and the vectors view refreshes on demand."""
+        w2v = Word2Vec(toy_corpus(), layer_size=32, window=3,
+                       min_word_frequency=3, learning_rate=0.1,
+                       batch_pairs=2048, seed=7)
+        centers, contexts = w2v.mine_pairs()
+        assert centers.size == contexts.size > 0
+        assert centers.dtype == np.int32
+        n_vocab = w2v.vocab.num_words()
+        assert centers.max() < n_vocab and centers.min() >= 0
+        # the caller owns shuffling and decay (fit() does both per pass)
+        rng = np.random.RandomState(0)
+        trained = 0
+        for i in range(40):
+            perm = rng.permutation(centers.size)
+            trained += w2v.train_pairs(centers[perm], contexts[perm],
+                                       alpha=0.1 * (1 - i / 40))
+        assert trained >= 40 * (centers.size // w2v.batch_pairs
+                                * w2v.batch_pairs)
+        w2v.refresh_vectors()
+        assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "king")
+
+    def test_train_pairs_smaller_than_one_batch_tiles_up(self):
+        w2v = Word2Vec(toy_corpus(2), layer_size=8, window=2,
+                       min_word_frequency=1, batch_pairs=4096, seed=1)
+        centers, contexts = w2v.mine_pairs()
+        assert 0 < centers.size < w2v.batch_pairs
+        trained = w2v.train_pairs(centers, contexts)
+        assert trained == centers.size
+
     def test_unknown_word(self):
         w2v = Word2Vec(toy_corpus(1), layer_size=8, iterations=1).fit()
         assert not w2v.has_word("zebra")
