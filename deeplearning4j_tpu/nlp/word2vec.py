@@ -431,9 +431,11 @@ class Word2Vec(WordVectors):
     def train_pairs(self, centers, contexts, alpha: float = None) -> int:
         """Train on pre-mined pairs through the production chunked-scan
         step at a FIXED learning rate (callers own any decay schedule).
-        Truncates to whole chunks (chunk_batches x batch_pairs) unless
-        the input is smaller than one batch, which is tiled up. Returns
-        the number of pairs trained."""
+        Whole chunks (chunk_batches x batch_pairs) ride the scan; the
+        tail trains in single batches (each an eager dispatch), dropping
+        only the sub-batch remainder — unless the whole input is smaller
+        than one batch, which is tiled up. Returns the number of pairs
+        trained."""
         if self.syn0 is None:
             self.reset_weights()
         if self._step_cache is None:
@@ -445,8 +447,12 @@ class Word2Vec(WordVectors):
             tables["syn1"] = self.syn1
         if self.syn1neg is not None:
             tables["syn1neg"] = self.syn1neg
-        centers = np.asarray(centers, np.int32)
-        contexts = np.asarray(contexts, np.int32)
+        # jnp.asarray is a no-op for device-resident int32 inputs, so
+        # callers looping train_pairs can upload once and pay zero
+        # host->device transfer per call (the tunnel's per-transfer
+        # round trip would otherwise dominate)
+        centers = jnp.asarray(centers, jnp.int32)
+        contexts = jnp.asarray(contexts, jnp.int32)
         B, CB = self.batch_pairs, self.chunk_batches
         n = centers.size // (B * CB) * (B * CB)
         trained = 0
@@ -455,27 +461,23 @@ class Word2Vec(WordVectors):
             xb = contexts[:n].reshape(-1, CB, B)
             for i in range(cb.shape[0]):
                 self._key, k = jax.random.split(self._key)
-                tables, _ = step_chunk(tables, jnp.asarray(cb[i]),
-                                       jnp.asarray(xb[i]),
+                tables, _ = step_chunk(tables, cb[i], xb[i],
                                        jnp.float32(alpha), k)
             trained = n
         tail_c, tail_x = centers[n:], contexts[n:]
         for lo in range(0, tail_c.size // B * B, B):
             self._key, k = jax.random.split(self._key)
-            tables, _ = step(tables, jnp.asarray(tail_c[lo:lo + B]),
-                             jnp.asarray(tail_x[lo:lo + B]),
-                             jnp.float32(alpha), k)
+            tables, _ = step(tables, tail_c[lo:lo + B],
+                             tail_x[lo:lo + B], jnp.float32(alpha), k)
             trained += B
         rem = tail_c.size % B
         if rem and trained == 0:
             # smaller than one batch: tile up so tiny inputs still train
-            pad = np.arange(B - rem) % rem
+            pad = jnp.arange(B - rem) % rem
             self._key, k = jax.random.split(self._key)
             tables, _ = step(
-                tables, jnp.asarray(np.concatenate([tail_c[-rem:],
-                                                    tail_c[-rem:][pad]])),
-                jnp.asarray(np.concatenate([tail_x[-rem:],
-                                            tail_x[-rem:][pad]])),
+                tables, jnp.concatenate([tail_c[-rem:], tail_c[-rem:][pad]]),
+                jnp.concatenate([tail_x[-rem:], tail_x[-rem:][pad]]),
                 jnp.float32(alpha), k)
             trained = rem
         self.syn0 = tables["syn0"]

@@ -10,14 +10,14 @@ Parity: reference deeplearning4j-aws —
 - `Ec2BoxCreator` (cloud instance creation) and
   `DistributedDeepLearningTrainer` (main).
 
-TPU-native design: TPU pods are provisioned by the platform (gcloud /
-GKE), not by the trainer — so the box-creation half of the reference is
-the platform's job, and what remains is exactly what these classes do
-AFTER instances exist: copy artifacts to each host and start the worker
-process. Transports are pluggable: `LocalTransport` (same-host process
-spawn — the test tier and single-host multi-process runs) and
-`SshTransport` (OpenSSH subprocess — multi-host; keys/agent handled by
-ssh itself, no password prompts, no embedded JSch-style crypto).
+TPU-native design: box creation lives in `scaleout/boxes.py`
+(GceTpuBoxCreator drives the gcloud CLI; LocalBoxCreator is the embedded
+tier) and these classes do what the reference does AFTER instances
+exist: copy artifacts to each host and start the worker process.
+Transports are pluggable: `LocalTransport` (same-host process spawn —
+the test tier and single-host multi-process runs) and `SshTransport`
+(OpenSSH subprocess — multi-host; keys/agent handled by ssh itself, no
+password prompts, no embedded JSch-style crypto).
 Workers join the run through the ConfigRegistry + launcher, so
 provisioning only needs to start `python -m ...launcher worker` with the
 registry root and run name.
