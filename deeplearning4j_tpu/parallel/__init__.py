@@ -9,3 +9,7 @@ from deeplearning4j_tpu.parallel import multihost  # noqa: F401
 from deeplearning4j_tpu.parallel.sharded_update import (  # noqa: F401
     ShardedUpdateTrainer,
 )
+from deeplearning4j_tpu.parallel.tensor_parallel import (  # noqa: F401
+    TensorParallelTrainer,
+)
+from deeplearning4j_tpu.parallel import pipeline  # noqa: F401

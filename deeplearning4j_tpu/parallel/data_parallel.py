@@ -45,11 +45,10 @@ class DataParallelTrainer:
         self.updater = NetworkGradientUpdater.for_network(network)
         self._step = self._build_step()
 
-    def _build_step(self):
+    def _step_fn(self):
+        """The shared train-step body; subclasses vary only shardings."""
         net = self.network
         updater = self.updater
-        rep = replicated(self.mesh)
-        bsh = batch_sharding(self.mesh, self.axis)
 
         def step(params, upd_state, x, labels, rng):
             score, grads = jax.value_and_grad(net.loss_fn)(
@@ -59,12 +58,23 @@ class DataParallelTrainer:
             params = jax.tree_util.tree_map(lambda p, u: p - u, params, updates)
             return params, upd_state, score
 
+        return step
+
+    def _step_shardings(self):
+        """(in_shardings, out_shardings) for (params, upd_state, x,
+        labels, rng) -> (params, upd_state, score)."""
+        rep = replicated(self.mesh)
+        bsh = batch_sharding(self.mesh, self.axis)
+        return (rep, rep, bsh, bsh, rep), (rep, rep, rep)
+
+    def _build_step(self):
+        ins, outs = self._step_shardings()
         # donate params/updater state (outputs alias their HBM; fit()
         # rebinds both from the outputs every step)
         return jax.jit(
-            step,
-            in_shardings=(rep, rep, bsh, bsh, rep),
-            out_shardings=(rep, rep, rep),
+            self._step_fn(),
+            in_shardings=ins,
+            out_shardings=outs,
             donate_argnums=(0, 1),
         )
 

@@ -1,0 +1,117 @@
+"""Tensor (model) parallelism for the dense stack — beyond parity.
+
+The reference is data-parallel only (SURVEY §2.8: TP/PP/SP "ABSENT in
+reference"); on TPU, tensor parallelism is how a layer that doesn't fit
+(or saturate) one chip spans the mesh. Design follows the scaling-book
+recipe — pick a mesh, annotate shardings, let XLA insert collectives:
+
+- eligible 2-D weight matrices alternate **column split**
+  (W: P(None, model), b: P(model) — activations come out
+  feature-sharded) and **row split** (W: P(model, None), b replicated —
+  XLA inserts the psum over `model` to unshard the products), so
+  consecutive layers chain with exactly one all-reduce per row-split
+  layer and no resharding of activations in between (Megatron-style
+  pairing, expressed purely as GSPMD shardings);
+- the batch is simultaneously sharded over the `data` axis, giving
+  tp x dp on one 2-D mesh;
+- optimizer state (AdaGrad hist / momentum velocity) shards exactly like
+  its parameter, so update math is local to each shard (the ZeRO-spirit
+  follow-on to parallel/sharded_update.py, here falling out of the
+  sharding annotations for free).
+
+Non-2-D layers (conv stacks etc.) and the small output layer stay
+replicated; uneven splits raise rather than silently padding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.optimize.updater import UpdaterState
+from deeplearning4j_tpu.parallel.data_parallel import DataParallelTrainer
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+class TensorParallelTrainer(DataParallelTrainer):
+    """tp x dp training: batch over `data`, alternating column/row weight
+    splits over `model`. Mesh must carry BOTH axes."""
+
+    def __init__(self, network, mesh, model_axis: str = MODEL_AXIS,
+                 axis: str = DATA_AXIS):
+        if model_axis not in mesh.axis_names:
+            raise ValueError(
+                f"mesh {mesh.axis_names} has no {model_axis!r} axis")
+        self.model_axis = model_axis
+        self.tp = int(mesh.shape[model_axis])
+        super().__init__(network, mesh, axis=axis)
+
+    # ------------------------------------------------------------ shardings
+    def _param_specs(self):
+        """Per-layer {name: PartitionSpec}, alternating col/row splits
+        over eligible layers; the LAST layer (output head) replicates."""
+        net = self.network
+        specs = {}
+        col_next = True
+        last = len(net.layers) - 1
+        for i in range(len(net.layers)):
+            table = net._params[str(i)]
+            layer_spec = {name: P() for name in table}
+            w = table.get("W")
+            eligible = (w is not None and getattr(w, "ndim", 0) == 2
+                        and set(table) <= {"W", "b"} and i != last)
+            if eligible:
+                n_in, n_out = w.shape
+                if col_next and n_out % self.tp == 0:
+                    layer_spec["W"] = P(None, self.model_axis)
+                    if "b" in table:  # b is (1, n_out): split its lanes
+                        b = table["b"]
+                        layer_spec["b"] = (
+                            P(None, self.model_axis)
+                            if getattr(b, "ndim", 1) == 2
+                            else P(self.model_axis))
+                    col_next = False
+                elif not col_next and n_in % self.tp == 0:
+                    layer_spec["W"] = P(self.model_axis, None)
+                    # b adds to the psum-unsharded output: replicated
+                    col_next = True
+                # an indivisible dim leaves the layer replicated and the
+                # alternation state unchanged (the chain stays coherent)
+            specs[str(i)] = layer_spec
+        return specs
+
+    def _step_shardings(self):
+        mesh = self.mesh
+        specs = self._param_specs()
+        if not any(s != P() for table in specs.values()
+                   for s in table.values()):
+            raise ValueError(
+                f"no layer is splittable over {self.tp} model shards "
+                "(need 2-D dense weights with divisible dims)")
+
+        def named(spec_tree):
+            return jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), spec_tree,
+                is_leaf=lambda x: isinstance(x, P))
+
+        param_sh = named(specs)
+        # optimizer state mirrors params leaf-for-leaf; iteration scalar
+        # replicates
+        upd_sh = {
+            k: UpdaterState(hist=param_sh[k], velocity=param_sh[k],
+                            iteration=NamedSharding(mesh, P()))
+            for k in param_sh
+        }
+        rep = NamedSharding(mesh, P())
+        bsh = NamedSharding(mesh, P(self.axis))
+        return (param_sh, upd_sh, bsh, bsh, rep), (param_sh, upd_sh, rep)
+
+    def sharding_summary(self):
+        """{layer: {param: spec}} for logging/tests."""
+        return {k: {n: str(s) for n, s in t.items()}
+                for k, t in self._param_specs().items()}
+
+
+__all__ = ["TensorParallelTrainer"]

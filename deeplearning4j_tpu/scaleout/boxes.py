@@ -140,13 +140,24 @@ class GceTpuBoxCreator(BoxCreator):
         return hosts
 
     def blow_away(self) -> None:
-        # pop each slice only after ITS delete succeeds, so a retry
-        # after a transient failure converges on the leaked ones instead
-        # of aborting on already-deleted names
-        while self.created:
-            name = self.created[0]
-            self.runner(self._base("delete", name) + ["--quiet"])
-            self.created.pop(0)
+        # every slice gets its delete attempt (one failure must not leak
+        # the rest — these are billed machines); already-gone slices are
+        # treated as success, other failures stay in `created` so a
+        # retry converges, and the combined error is raised at the end
+        errors = []
+        remaining = []
+        for name in self.created:
+            try:
+                self.runner(self._base("delete", name) + ["--quiet"])
+            except RuntimeError as e:
+                if "not found" in str(e).lower():
+                    continue  # deleted out-of-band: goal state reached
+                errors.append(f"{name}: {e}")
+                remaining.append(name)
+        self.created = remaining
+        if errors:
+            raise RuntimeError("blow_away left slice(s) running: "
+                               + "; ".join(errors))
 
     def transport_for(self, host: str) -> Transport:
         return SshTransport(host, user=self.ssh_user)

@@ -65,6 +65,30 @@ class TestGceTpuBoxCreator:
         assert all("--quiet" in c for c in deletes)
         assert creator.created == []
 
+    def test_blow_away_survives_partial_failure(self):
+        """One failed delete must not leak the rest (billed machines):
+        not-found counts as success, transient failures stay tracked for
+        retry, and every slice gets its attempt."""
+        class FlakyRunner(RecordingRunner):
+            def __call__(self, argv):
+                out = super().__call__(argv)
+                if "delete" in argv:
+                    name = argv[argv.index("delete") + 1]
+                    if name == "x-0":
+                        raise RuntimeError("gcloud failed: NOT FOUND")
+                    if name == "x-1":
+                        raise RuntimeError("gcloud failed: quota flake")
+                return out
+
+        runner = FlakyRunner(hosts_per_slice=1)
+        creator = GceTpuBoxCreator("x", zone="z", n_slices=3, runner=runner)
+        creator.create()
+        with pytest.raises(RuntimeError, match="x-1"):
+            creator.blow_away()
+        deletes = [c for c in runner.calls if "delete" in c]
+        assert len(deletes) == 3  # every slice attempted
+        assert creator.created == ["x-1"]  # only the flake remains
+
     def test_describe_without_endpoints_raises(self):
         class EmptyRunner(RecordingRunner):
             def __call__(self, argv):

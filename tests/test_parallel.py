@@ -152,3 +152,140 @@ class TestShardedUpdateTrainer:
         with pytest.raises(ValueError, match="global norm"):
             ShardedUpdateTrainer(MultiLayerNetwork(conf),
                                  make_mesh({"data": 8}))
+
+
+class TestTensorParallelTrainer:
+    """tp x dp: alternating column/row weight splits over the `model`
+    axis (Megatron-style pairing via GSPMD shardings) — beyond parity
+    (the reference is data-parallel only, SURVEY §2.8)."""
+
+    def _nets(self, hidden=(8, 8)):
+        conf = mlp_conf(lr=0.1, iters=1, hidden=hidden)
+        a, b = MultiLayerNetwork(conf), MultiLayerNetwork(conf)
+        b.set_parameters(np.asarray(a.params()))
+        return a, b
+
+    def test_sharding_plan_alternates_col_row(self):
+        from deeplearning4j_tpu.parallel import TensorParallelTrainer
+
+        net, _ = self._nets(hidden=(8, 8))
+        mesh = make_mesh({"data": 4, "model": 2})
+        tp = TensorParallelTrainer(net, mesh)
+        plan = tp.sharding_summary()
+        # layer 0 column-split, layer 1 row-split, output replicated
+        assert plan["0"]["W"] == "PartitionSpec(None, 'model')"
+        assert plan["0"]["b"] == "PartitionSpec(None, 'model')"
+        assert plan["1"]["W"] == "PartitionSpec('model', None)"
+        assert plan["1"]["b"] == "PartitionSpec()"
+        assert plan["2"]["W"] == "PartitionSpec()"
+
+    def test_matches_replicated_training_and_learns(self):
+        from deeplearning4j_tpu.parallel import TensorParallelTrainer
+
+        x, y = load_iris()
+        x, y = np.asarray(x)[:144], np.asarray(y)[:144]
+        a, b = self._nets(hidden=(8, 8))
+        mesh_dp = make_mesh({"data": 8})
+        mesh_tp = make_mesh({"data": 4, "model": 2})
+        dp = DataParallelTrainer(a, mesh_dp)
+        tp = TensorParallelTrainer(b, mesh_tp)
+        it_a = ListDataSetIterator(DataSet(x, y), batch_size=48)
+        it_b = ListDataSetIterator(DataSet(x, y), batch_size=48)
+        initial = a.score(x, y)
+        for _ in range(20):
+            dp.fit(it_a, epochs=1)
+            tp.fit(it_b, epochs=1)
+        # same math, different sharding: scores agree to float tolerance
+        sa, sb = a.score(x, y), b.score(x, y)
+        assert sa < initial * 0.7
+        np.testing.assert_allclose(sb, sa, rtol=2e-3)
+        np.testing.assert_allclose(np.asarray(b.params()),
+                                   np.asarray(a.params()), atol=5e-4)
+
+    def test_requires_model_axis(self):
+        from deeplearning4j_tpu.parallel import TensorParallelTrainer
+
+        net, _ = self._nets()
+        with pytest.raises(ValueError, match="model"):
+            TensorParallelTrainer(net, make_mesh({"data": 8}))
+
+    def test_indivisible_dims_raise_when_nothing_splits(self):
+        from deeplearning4j_tpu.parallel import TensorParallelTrainer
+
+        # hidden 7 not divisible by tp=2 anywhere -> no splittable layer
+        net, _ = self._nets(hidden=(7,))
+        mesh = make_mesh({"data": 4, "model": 2})
+        with pytest.raises(ValueError, match="splittable"):
+            TensorParallelTrainer(net, mesh)
+
+
+class TestPipelineParallel:
+    """GPipe-style microbatch pipelining over a `pipe` mesh axis
+    (beyond parity): scan schedule + ppermute stage hand-off, autodiff
+    through the pipeline, pp x dp composition."""
+
+    def _setup(self, n_stages=4, width=16, m=6, b=8):
+        from deeplearning4j_tpu.parallel.pipeline import init_pipeline_params
+
+        params = init_pipeline_params(jax.random.PRNGKey(0), n_stages, width)
+        xm = jax.random.normal(jax.random.PRNGKey(1), (m, b, width))
+        ym = jax.random.normal(jax.random.PRNGKey(2), (m, b, width))
+        return params, xm, ym
+
+    def test_forward_matches_sequential(self):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.parallel.pipeline import (pipeline_apply,
+                                                          sequential_apply)
+
+        params, xm, _ = self._setup()
+        mesh = make_mesh({"pipe": 4}, devices=jax.devices()[:4])
+        out = pipeline_apply(params, xm, mesh)
+        ref = jnp.stack([sequential_apply(params, xm[i])
+                         for i in range(xm.shape[0])])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6)
+
+    def test_grad_step_matches_sequential(self):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.parallel.pipeline import (
+            pipeline_grad_step, sequential_apply)
+
+        params, xm, ym = self._setup()
+        mesh = make_mesh({"pipe": 4}, devices=jax.devices()[:4])
+        p2, loss = pipeline_grad_step(params, xm, ym, mesh)
+
+        def seq_loss(p):
+            out = jnp.stack([sequential_apply(p, xm[i])
+                             for i in range(xm.shape[0])])
+            return jnp.mean((out - ym) ** 2)
+
+        ls, gs = jax.value_and_grad(seq_loss)(params)
+        p_ref = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, gs)
+        assert abs(float(loss) - float(ls)) < 1e-6
+        for a, b in zip(jax.tree_util.tree_leaves(p2),
+                        jax.tree_util.tree_leaves(p_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+
+    def test_pp_x_dp_composes(self):
+        from deeplearning4j_tpu.parallel.pipeline import pipeline_grad_step
+
+        params, xm, ym = self._setup()
+        mesh1 = make_mesh({"pipe": 4}, devices=jax.devices()[:4])
+        mesh2 = make_mesh({"pipe": 4, "data": 2})
+        _, loss1 = pipeline_grad_step(params, xm, ym, mesh1)
+        _, loss2 = pipeline_grad_step(params, xm, ym, mesh2,
+                                      data_axis="data")
+        np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+
+    def test_stage_count_must_match_mesh(self):
+        import pytest
+
+        from deeplearning4j_tpu.parallel.pipeline import pipeline_apply
+
+        params, xm, _ = self._setup(n_stages=3)
+        mesh = make_mesh({"pipe": 4}, devices=jax.devices()[:4])
+        with pytest.raises(ValueError, match="stages"):
+            pipeline_apply(params, xm, mesh)
