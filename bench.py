@@ -508,6 +508,12 @@ def main() -> None:
             if res.get("lower_is_better"):
                 ratio = base / res["value"]
             res["vs_baseline"] = round(ratio, 4)
+            # between-process spread recorded at pin time (BASELINE.md):
+            # a vs_baseline inside the pin's spread band is tunnel
+            # weather, not signal
+            spread = hist.get("pin_info", {}).get("spread", {}).get(name)
+            if spread and platform == "tpu":
+                res["pin_spread"] = spread
         results[name] = res
         run_entry["results"][name] = res
         _write_history(hist)
