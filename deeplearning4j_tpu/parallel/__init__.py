@@ -13,3 +13,4 @@ from deeplearning4j_tpu.parallel.tensor_parallel import (  # noqa: F401
     TensorParallelTrainer,
 )
 from deeplearning4j_tpu.parallel import pipeline  # noqa: F401
+from deeplearning4j_tpu.parallel import expert_parallel  # noqa: F401

@@ -289,3 +289,80 @@ class TestPipelineParallel:
         mesh = make_mesh({"pipe": 4}, devices=jax.devices()[:4])
         with pytest.raises(ValueError, match="stages"):
             pipeline_apply(params, xm, mesh)
+
+
+class TestExpertParallel:
+    """MoE expert parallelism over an `expert` mesh axis (beyond
+    parity): top-1 switch gating, dense/masked dispatch, psum combine;
+    exact vs the unsharded reference; ep x dp composes."""
+
+    def _setup(self, n_experts=8, d=16):
+        from deeplearning4j_tpu.parallel.expert_parallel import (
+            init_moe_params)
+
+        params = init_moe_params(jax.random.PRNGKey(0), n_experts, d, 32)
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, d))
+        y = jax.random.normal(jax.random.PRNGKey(2), (64, d))
+        return params, x, y
+
+    def test_forward_matches_dense_reference(self):
+        from deeplearning4j_tpu.parallel.expert_parallel import (
+            moe_apply, moe_reference)
+
+        params, x, _ = self._setup()
+        mesh = make_mesh({"expert": 4}, devices=jax.devices()[:4])
+        out = moe_apply(params, x, mesh)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(moe_reference(params, x)),
+                                   atol=1e-6)
+
+    def test_grad_step_matches_and_router_learns(self):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.parallel.expert_parallel import (
+            moe_grad_step, moe_reference)
+
+        params, x, y = self._setup()
+        mesh = make_mesh({"expert": 4}, devices=jax.devices()[:4])
+
+        def ref_loss(p):
+            return jnp.mean((moe_reference(p, x) - y) ** 2)
+
+        ls, gs = jax.value_and_grad(ref_loss)(params)
+        p_ref = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, gs)
+        p2, loss = moe_grad_step(params, x, y, mesh)
+        assert abs(float(loss) - float(ls)) < 1e-6
+        for a, b in zip(jax.tree_util.tree_leaves(p2),
+                        jax.tree_util.tree_leaves(p_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+        # the router gets gradient (gate params move)
+        assert float(jnp.max(jnp.abs(p2["gate"] - params["gate"]))) > 0
+
+    def test_ep_x_dp_composes(self):
+        from deeplearning4j_tpu.parallel.expert_parallel import (
+            moe_grad_step)
+
+        params, x, y = self._setup()
+        mesh1 = make_mesh({"expert": 4}, devices=jax.devices()[:4])
+        mesh2 = make_mesh({"expert": 4, "data": 2})
+        p1, l1 = moe_grad_step(params, x, y, mesh1)
+        p2, l2 = moe_grad_step(params, x, y, mesh2, data_axis="data")
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        # the UPDATED params must agree too — loss alone is computed
+        # from pre-update params and wouldn't catch a broken dp-composed
+        # backward (psum transpose of the replicated gate, data-mean)
+        for a, b in zip(jax.tree_util.tree_leaves(p1),
+                        jax.tree_util.tree_leaves(p2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+
+    def test_indivisible_expert_count_raises(self):
+        import pytest
+
+        from deeplearning4j_tpu.parallel.expert_parallel import moe_apply
+
+        params, x, _ = self._setup(n_experts=6)
+        mesh = make_mesh({"expert": 4}, devices=jax.devices()[:4])
+        with pytest.raises(ValueError, match="divisible"):
+            moe_apply(params, x, mesh)
