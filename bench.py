@@ -450,7 +450,7 @@ def _write_history(hist) -> None:
 def _summary_line(results) -> str:
     primary_name = "mlp" if "mlp" in results else next(iter(results), None)
     primary = results.get(primary_name, {})
-    return json.dumps({
+    summary = {
         "metric": METRIC_NAMES.get(primary_name, primary_name or "none"),
         "value": primary.get("value"),
         "unit": primary.get("unit"),
@@ -459,7 +459,11 @@ def _summary_line(results) -> str:
         "vs_baseline": primary.get("vs_baseline"),
         "protocol": PROTOCOL,
         "extra": {k: v for k, v in results.items() if k != primary_name},
-    })
+    }
+    for key in ("error", "skipped"):  # surface WHY the primary is null
+        if key in primary:
+            summary[key] = primary[key]
+    return json.dumps(summary)
 
 
 def main() -> None:
