@@ -82,22 +82,69 @@ class BaseOptimizer:
     def init_state(self, x):
         return ()
 
+    # ---------------------------------------------- device-side fast loop
+    #: optimizers that implement make_loop() run their WHOLE iteration
+    #: loop as one compiled lax.while_loop when (a) no per-iteration
+    #: listeners are attached and (b) every termination condition is one
+    #: of the jittable reference trio. On the tunneled chip the eager
+    #: loop costs a host round trip PER ITERATION (the float(score)
+    #: sync), which dominates multi-iteration pretraining.
+    _JITTABLE_TERMS = (EpsTermination, ZeroDirection, Norm2Termination)
+
+    def _device_loop_eligible(self) -> bool:
+        return (not self.listeners
+                and all(isinstance(t, self._JITTABLE_TERMS)
+                        for t in self.terminations))
+
+    def _terminate_traced(self, new_score, old_score, gnorm):
+        """The reference termination trio as traced predicates — same
+        math as terminations.py, on device."""
+        conds = []
+        for t in self.terminations:
+            if isinstance(t, EpsTermination):
+                finite = jnp.isfinite(new_score) & jnp.isfinite(old_score)
+                denom = (jnp.abs(old_score) + jnp.abs(new_score)
+                         + t.tolerance)
+                conds.append(finite & (
+                    2.0 * jnp.abs(new_score - old_score) / denom < t.eps))
+            elif isinstance(t, ZeroDirection):
+                conds.append(gnorm == 0.0)
+            elif isinstance(t, Norm2Termination):
+                conds.append(gnorm < t.gradient_tolerance)
+        out = jnp.asarray(False)
+        for c in conds:
+            out = out | c
+        return out
+
+    make_loop = None  # subclasses may provide: (n_iters) -> jitted loop
+
     def optimize(self, params, *data, rng_key=None):
         """Run the loop; params is a pytree; returns (params, final_score).
         `data` arrays are forwarded to the loss as traced arguments;
         `rng_key` overrides the construction-time key (fresh stochasticity
         per mini-batch without recompiling)."""
         x, unravel = ravel_pytree(params)
+        if rng_key is None:
+            rng_key = self.rng_key
+        base_key = (rng_key if rng_key is not None
+                    else jax.random.PRNGKey(0))
+        if (self.make_loop is not None and self._device_loop_eligible()
+                and self.conf.num_iterations > 1):
+            if getattr(self, "_loop", None) is None:
+                self._loop = self.make_loop(self.conf.num_iterations)
+            x, score_arr = self._loop(x, base_key, *data)
+            score = float(score_arr)
+            for listener in self.listeners:  # empty by eligibility, but
+                done = getattr(listener, "optimization_done", None)
+                if done is not None:  # keep the contract future-proof
+                    done(self.model)
+            return unravel(x), score
         if self._step is None:
             self._step = self.make_step()
         step = self._step
         state = self.init_state(x)
         old_score = float("inf")
         score = None
-        if rng_key is None:
-            rng_key = self.rng_key
-        base_key = (rng_key if rng_key is not None
-                    else jax.random.PRNGKey(0))
         for i in range(self.conf.num_iterations):
             x, state, score_arr, gnorm_arr = step(
                 x, state, jax.random.fold_in(base_key, i), *data)
@@ -144,6 +191,46 @@ class IterationGradientDescent(BaseOptimizer):
             return x - sign * updates, state, score, jnp.linalg.norm(g)
 
         return step
+
+    def make_loop(self, n_iters: int):
+        """Whole optimize() loop as ONE compiled while_loop — identical
+        iteration math and termination checks to the eager path (same
+        per-iteration fold_in keys), minus the per-iteration host sync.
+        Selected by BaseOptimizer.optimize when no listeners need
+        per-iteration callbacks."""
+        updater = GradientUpdater(self.conf)
+        sign = 1.0 if self.conf.minimize else -1.0
+        terminate = self._terminate_traced
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def run(x, base_key, *data):
+            bs = data[0].shape[0] if data and hasattr(data[0], "shape") \
+                and getattr(data[0], "ndim", 0) >= 1 else 1
+            inf = jnp.float32(jnp.inf)
+
+            def cond(carry):
+                i, x, state, score, old, gnorm = carry
+                # the eager loop checks terminations AFTER each step;
+                # checking before the NEXT step is the same schedule —
+                # guard i == 0 so the init sentinels never terminate
+                return (i < n_iters) & ((i == 0)
+                                        | ~terminate(score, old, gnorm))
+
+            def body(carry):
+                i, x, state, score, old, gnorm = carry
+                new_score, g = jax.value_and_grad(self.loss)(
+                    x, jax.random.fold_in(base_key, i), *data)
+                updates, state = updater.update(g, state, x, bs)
+                return (i + 1, x - sign * updates, state,
+                        new_score.astype(jnp.float32), score,
+                        jnp.linalg.norm(g).astype(jnp.float32))
+
+            init = (jnp.int32(0), x, updater.init(x), inf, inf,
+                    jnp.float32(0.0))
+            _, x, _, score, _, _ = jax.lax.while_loop(cond, body, init)
+            return x, score
+
+        return run
 
 
 class GradientAscent(BaseOptimizer):
