@@ -29,6 +29,7 @@ from deeplearning4j_tpu.optimize.line_search import backtrack_line_search
 from deeplearning4j_tpu.optimize.listeners import IterationListener
 from deeplearning4j_tpu.optimize.terminations import (
     EpsTermination,
+    Norm2Termination,
     TerminationCondition,
     ZeroDirection,
 )
