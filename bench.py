@@ -342,8 +342,11 @@ def bench_flash():
         raise AssertionError(f"flash vs blockwise max err {err}")
 
     steps = 2 if fast else 1500
+    # keep-alive: scale by a tiny NON-zero constant — x*0 could legally be
+    # folded to 0 by the algebraic simplifier, DCE-ing the kernel; 1e-8
+    # rounds away in the bf16 add so the carry stays numerically fixed
     loop = jax.jit(lambda q, k, v: jax.lax.scan(
-        lambda c, _: (q + jnp.bfloat16(0.0) * flash(c, k, v)[0, 0, :1, :1],
+        lambda c, _: (q + jnp.bfloat16(1e-8) * flash(c, k, v)[0, 0, :1, :1],
                       None), q, None, length=steps)[0])
     jax.block_until_ready(loop(q, k, v))
 
@@ -382,7 +385,8 @@ def bench_flash_bwd():
     def body(c, _):
         dq, dk, dv = grad(c, k, v)
         probe = dq[0, 0, :1, :1] + dk[0, 0, :1, :1] + dv[0, 0, :1, :1]
-        return q + jnp.bfloat16(0.0) * probe, None
+        # non-zero scale so the probe dependence can't be constant-folded
+        return q + jnp.bfloat16(1e-8) * probe, None
 
     loop = jax.jit(lambda q, k, v: jax.lax.scan(
         body, q, None, length=steps)[0])

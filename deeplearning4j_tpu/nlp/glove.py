@@ -108,8 +108,10 @@ class CoOccurrences:
 
     @property
     def counts(self) -> Dict[Tuple[int, int], float]:
-        """Dict view of the counts (small-corpus convenience; the
-        training path uses triples() arrays directly)."""
+        """READ-ONLY dict view of the counts, rebuilt on every access
+        (small-corpus convenience; the training path uses triples()
+        arrays directly). Mutating the returned dict does NOT write back
+        into the accumulator — modify via count()/accumulate instead."""
         return defaultdict(float, {
             (int(r), int(c)): float(x)
             for r, c, x in zip(self._rows, self._cols, self._vals)})

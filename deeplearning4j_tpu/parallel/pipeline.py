@@ -75,7 +75,9 @@ def pipeline_apply(params, xm, mesh: Mesh, axis: str = PIPE_AXIS,
         # pipe axis so the scan carry types stay consistent once values
         # mix with the per-stage params (new shard_map's vma tracking;
         # a no-op under the older experimental API)
-        if hasattr(jax.lax, "pvary"):
+        if hasattr(jax.lax, "pcast"):
+            xs = jax.lax.pcast(xs, (axis,), to="varying")
+        elif hasattr(jax.lax, "pvary"):  # pre-pcast jax
             xs = jax.lax.pvary(xs, (axis,))
         buf = jnp.zeros_like(xs[0])   # activation arriving from the left
         outs = jnp.zeros_like(xs)     # last stage's collected outputs
