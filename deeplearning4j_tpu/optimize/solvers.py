@@ -76,20 +76,28 @@ class BaseOptimizer:
         else:
             self.loss = lambda x, key, *data: loss(x, *data)
 
-    # subclasses: (x, state, key, *data) -> (x, state, score, grad_norm)
-    def make_step(self):
+    # subclasses: raw traceable (x, state, key, *data) ->
+    # (x, state, score, grad_norm); make_step/make_loop wrap it
+    def _step_fn(self):
         raise NotImplementedError
+
+    #: argnums make_step donates (IGD donates params+state buffers)
+    _donate: tuple = ()
+
+    def make_step(self):
+        return jax.jit(self._step_fn(), donate_argnums=self._donate)
 
     def init_state(self, x):
         return ()
 
     # ---------------------------------------------- device-side fast loop
-    #: optimizers that implement make_loop() run their WHOLE iteration
-    #: loop as one compiled lax.while_loop when (a) no per-iteration
-    #: listeners are attached and (b) every termination condition is one
-    #: of the jittable reference trio. On the tunneled chip the eager
-    #: loop costs a host round trip PER ITERATION (the float(score)
-    #: sync), which dominates multi-iteration pretraining.
+    #: optimizers whose _step_fn is a pure traced function (all five
+    #: solvers here) run their WHOLE iteration loop as one compiled
+    #: lax.while_loop when (a) no per-iteration listeners are attached
+    #: and (b) every termination condition is one of the jittable
+    #: reference trio. On the tunneled chip the eager loop costs a host
+    #: round trip PER ITERATION (the float(score) sync), which dominates
+    #: multi-iteration pretraining.
     _JITTABLE_TERMS = (EpsTermination, ZeroDirection, Norm2Termination)
 
     def _device_loop_eligible(self) -> bool:
@@ -117,24 +125,80 @@ class BaseOptimizer:
             out = out | c
         return out
 
-    make_loop = None  # subclasses may provide: (n_iters) -> jitted loop
+    def make_loop(self, n_iters: int):
+        """The whole optimize() loop as ONE compiled while_loop — identical
+        iteration math and termination checks to the eager path (same
+        per-iteration fold_in keys, same check-after-step schedule), minus
+        the per-iteration host sync. Works for every solver whose step is
+        a pure traced function (all five here)."""
+        step = self._step_fn()
+        terminate = self._terminate_traced
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def run(x, base_key, *data):
+            inf = jnp.float32(jnp.inf)
+
+            def cond(carry):
+                i, x, state, score, old, gnorm = carry
+                # the eager loop checks terminations AFTER each step;
+                # checking before the NEXT step is the same schedule —
+                # guard i == 0 so the init sentinels never terminate
+                return (i < n_iters) & ((i == 0)
+                                        | ~terminate(score, old, gnorm))
+
+            def body(carry):
+                i, x, state, score, old, gnorm = carry
+                new_x, new_state, new_score, new_gnorm = step(
+                    x, state, jax.random.fold_in(base_key, i), *data)
+                return (i + 1, new_x, new_state,
+                        new_score.astype(jnp.float32), score,
+                        new_gnorm.astype(jnp.float32))
+
+            init = (jnp.int32(0), x, self.init_state(x), inf, inf,
+                    jnp.float32(0.0))
+            _, x, _, score, _, _ = jax.lax.while_loop(cond, body, init)
+            return x, score
+
+        return run
+
+    def _has_device_loop(self) -> bool:
+        # old-style subclasses that override make_step without providing
+        # a raw _step_fn can't build the traced loop — fall back to eager
+        return type(self)._step_fn is not BaseOptimizer._step_fn
 
     def optimize(self, params, *data, rng_key=None):
         """Run the loop; params is a pytree; returns (params, final_score).
         `data` arrays are forwarded to the loss as traced arguments;
         `rng_key` overrides the construction-time key (fresh stochasticity
-        per mini-batch without recompiling)."""
+        per mini-batch without recompiling).
+
+        When the device loop is taken (no listeners + jittable
+        terminations + num_iterations > 1), `final_score` is a live
+        float32 DEVICE scalar, not a Python float — callers that need the
+        value call float() on it; callers that don't avoid the host
+        round-trip entirely (that sync is the whole cost of layer-wise
+        pretraining through a tunneled chip)."""
         x, unravel = ravel_pytree(params)
         if rng_key is None:
             rng_key = self.rng_key
         base_key = (rng_key if rng_key is not None
                     else jax.random.PRNGKey(0))
-        if (self.make_loop is not None and self._device_loop_eligible()
+        if (self._has_device_loop() and self._device_loop_eligible()
                 and self.conf.num_iterations > 1):
-            if getattr(self, "_loop", None) is None:
+            # cache keyed on what optimize() itself reads per call
+            # (iteration count + termination config): mutating those
+            # between calls must recompile, not reuse the stale loop.
+            # Hyperparameters (lr, momentum, history, ...) are baked at
+            # first compile on BOTH paths — the cached eager self._step
+            # closes over them the same way — so they are not keyed.
+            loop_key = (self.conf.num_iterations,
+                        tuple((type(t).__name__,
+                               tuple(sorted(vars(t).items())))
+                              for t in self.terminations))
+            if getattr(self, "_loop_key", None) != loop_key:
                 self._loop = self.make_loop(self.conf.num_iterations)
-            x, score_arr = self._loop(x, base_key, *data)
-            score = float(score_arr)
+                self._loop_key = loop_key
+            x, score = self._loop(x, base_key, *data)
             for listener in self.listeners:  # empty by eligibility, but
                 done = getattr(listener, "optimization_done", None)
                 if done is not None:  # keep the contract future-proof
@@ -170,18 +234,19 @@ class IterationGradientDescent(BaseOptimizer):
     """Plain SGD with GradientAdjustment semantics (reference
     IterationGradientDescent + GradientAdjustment.java:66-113)."""
 
+    # donate x/state: outputs alias their HBM instead of reallocating
+    # per iteration (same win as MultiLayerNetwork._get_train_step);
+    # optimize() rebinds both from the outputs every iteration
+    _donate = (0, 1)
+
     def init_state(self, x):
         updater = GradientUpdater(self.conf)
         return updater.init(x)
 
-    def make_step(self):
+    def _step_fn(self):
         updater = GradientUpdater(self.conf)
         sign = 1.0 if self.conf.minimize else -1.0
 
-        # donate x/state: outputs alias their HBM instead of reallocating
-        # per iteration (same win as MultiLayerNetwork._get_train_step);
-        # optimize() rebinds both from the outputs every iteration
-        @partial(jax.jit, donate_argnums=(0, 1))
         def step(x, state, key, *data):
             score, g = jax.value_and_grad(self.loss)(x, key, *data)
             # data[0] (when present) is the mini-batch: its leading dim is
@@ -193,56 +258,15 @@ class IterationGradientDescent(BaseOptimizer):
 
         return step
 
-    def make_loop(self, n_iters: int):
-        """Whole optimize() loop as ONE compiled while_loop — identical
-        iteration math and termination checks to the eager path (same
-        per-iteration fold_in keys), minus the per-iteration host sync.
-        Selected by BaseOptimizer.optimize when no listeners need
-        per-iteration callbacks."""
-        updater = GradientUpdater(self.conf)
-        sign = 1.0 if self.conf.minimize else -1.0
-        terminate = self._terminate_traced
-
-        @partial(jax.jit, donate_argnums=(0,))
-        def run(x, base_key, *data):
-            bs = data[0].shape[0] if data and hasattr(data[0], "shape") \
-                and getattr(data[0], "ndim", 0) >= 1 else 1
-            inf = jnp.float32(jnp.inf)
-
-            def cond(carry):
-                i, x, state, score, old, gnorm = carry
-                # the eager loop checks terminations AFTER each step;
-                # checking before the NEXT step is the same schedule —
-                # guard i == 0 so the init sentinels never terminate
-                return (i < n_iters) & ((i == 0)
-                                        | ~terminate(score, old, gnorm))
-
-            def body(carry):
-                i, x, state, score, old, gnorm = carry
-                new_score, g = jax.value_and_grad(self.loss)(
-                    x, jax.random.fold_in(base_key, i), *data)
-                updates, state = updater.update(g, state, x, bs)
-                return (i + 1, x - sign * updates, state,
-                        new_score.astype(jnp.float32), score,
-                        jnp.linalg.norm(g).astype(jnp.float32))
-
-            init = (jnp.int32(0), x, updater.init(x), inf, inf,
-                    jnp.float32(0.0))
-            _, x, _, score, _, _ = jax.lax.while_loop(cond, body, init)
-            return x, score
-
-        return run
-
 
 class GradientAscent(BaseOptimizer):
     """Line-search steepest descent (reference GradientAscent solver: the
     GRADIENT_DESCENT algorithm — normalized gradient direction + backtracking
     line search)."""
 
-    def make_step(self):
+    def _step_fn(self):
         max_iters = self.conf.num_line_search_iterations
 
-        @jax.jit
         def step(x, state, key, *data):
             score, g = jax.value_and_grad(self.loss)(x, key, *data)
             gnorm = jnp.linalg.norm(g)
@@ -263,10 +287,9 @@ class ConjugateGradient(BaseOptimizer):
     def init_state(self, x):
         return (jnp.zeros_like(x), jnp.zeros_like(x), jnp.asarray(True))
 
-    def make_step(self):
+    def _step_fn(self):
         max_iters = self.conf.num_line_search_iterations
 
-        @jax.jit
         def step(x, state, key, *data):
             g_prev, d_prev, first = state
             score, g = jax.value_and_grad(self.loss)(x, key, *data)
@@ -316,11 +339,10 @@ class LBFGS(BaseOptimizer):
             jnp.zeros_like(x),  # g_prev
         )
 
-    def make_step(self):
+    def _step_fn(self):
         m = self.history
         max_ls = self.conf.num_line_search_iterations
 
-        @jax.jit
         def step(x, state, key, *data):
             S, Y, rho, count, x_prev, g_prev = state
             score, g = jax.value_and_grad(self.loss)(x, key, *data)
@@ -399,7 +421,7 @@ class StochasticHessianFree(BaseOptimizer):
     def init_state(self, x):
         return jnp.asarray(self.initial_lambda, x.dtype)
 
-    def make_step(self):
+    def _step_fn(self):
         loss = self.loss
         cg_iters = self.cg_iterations
         user_matvec = self._user_matvec
@@ -410,7 +432,6 @@ class StochasticHessianFree(BaseOptimizer):
             return jax.jvp(jax.grad(lambda xx: loss(xx, key, *data)),
                            (x,), (v,))[1]
 
-        @jax.jit
         def step(x, lam, key, *data):
             score, g = jax.value_and_grad(loss)(x, key, *data)
             gnorm = jnp.linalg.norm(g)

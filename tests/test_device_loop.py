@@ -1,0 +1,318 @@
+"""Device-loop vs eager optimizer equivalence.
+
+The device-side fast loop (BaseOptimizer.make_loop) runs the whole
+optimize() iteration loop as one compiled lax.while_loop. These tests pin
+its contract against the eager reference path (BaseOptimizer.optimize's
+Python loop, which mirrors reference BaseOptimizer.java:128-195): identical
+parameter trajectory, identical final score, identical stop iteration, for
+every solver and for all three jittable termination conditions — including
+the two subtle schedule cases the loop must get right:
+
+- the init-sentinel guard: carry starts with score=inf/gnorm=0.0, and
+  ZeroDirection(gnorm == 0) or a naive EpsTermination would fire on those
+  sentinels at i == 0 before any step ran;
+- the check-after-step schedule: the eager path checks terminations with
+  (score_i, score_{i-1}, gnorm_i) AFTER applying step i's update, so the
+  loop's cond must see exactly that triple before running step i+1 — an
+  off-by-one in score/gnorm pairing shifts the stop iteration.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.optimize.solvers import (
+    BaseOptimizer,
+    ConjugateGradient,
+    GradientAscent,
+    IterationGradientDescent,
+    LBFGS,
+    StochasticHessianFree,
+)
+from deeplearning4j_tpu.optimize.terminations import (
+    EpsTermination,
+    Norm2Termination,
+    TerminationCondition,
+    ZeroDirection,
+)
+
+SOLVERS = [IterationGradientDescent, GradientAscent, ConjugateGradient,
+           LBFGS, StochasticHessianFree]
+
+
+def conf(iters=12, lr=0.1):
+    return (NeuralNetConfiguration.builder()
+            .lr(lr).num_iterations(iters).build())
+
+
+def quad_loss(x):
+    # strictly convex quadratic; gnorm decays geometrically under SGD
+    # (deterministic: takes (x, *data) — no rng key)
+    return 0.5 * jnp.sum(x * x)
+
+
+class _EagerSpy(TerminationCondition):
+    """Never terminates; records how many times the eager loop consulted
+    terminations (== iterations run). Non-jittable on purpose: its
+    presence forces the eager path."""
+
+    def __init__(self):
+        self.calls = []
+
+    def terminate(self, new_score, old_score, grad_norm):
+        self.calls.append((new_score, old_score, grad_norm))
+        return False
+
+
+def run_eager(cls, c, loss, x0, terminations, key=None, data=()):
+    opt = cls(c, loss, terminations=terminations, rng_key=key)
+    opt._has_device_loop = lambda: False   # force the eager Python loop
+    # fresh buffer: the solvers donate their params argument
+    return opt.optimize(jnp.array(x0, copy=True), *data, rng_key=key)
+
+
+def run_loop(cls, c, loss, x0, terminations, key=None, data=()):
+    opt = cls(c, loss, terminations=terminations, rng_key=key)
+    assert opt._has_device_loop() and opt._device_loop_eligible()
+    params, score = opt.optimize(jnp.array(x0, copy=True), *data,
+                                 rng_key=key)
+    # loop path must NOT have synced: score is a live device scalar
+    assert isinstance(score, jax.Array)
+    return params, score
+
+
+@pytest.mark.parametrize("cls", SOLVERS)
+def test_full_run_equivalence(cls):
+    """No termination fires: both paths run all iterations and agree."""
+    c = conf(iters=8, lr=0.05)
+    x0 = jnp.asarray(np.linspace(1.0, 2.0, 6), jnp.float32)
+    terms = [EpsTermination(eps=1e-30), ZeroDirection()]
+    xe, se = run_eager(cls, c, quad_loss, x0, terms)
+    xl, sl = run_loop(cls, c, quad_loss, x0, terms)
+    np.testing.assert_allclose(np.asarray(xl), np.asarray(xe),
+                               rtol=1e-5, atol=1e-6)
+    assert float(sl) == pytest.approx(float(se), rel=1e-5)
+
+
+@pytest.mark.parametrize("cls", SOLVERS)
+def test_stochastic_loss_same_fold_in_keys(cls):
+    """Stochastic losses get fold_in(base_key, i) per iteration on BOTH
+    paths — same noise stream, same trajectory."""
+
+    def noisy_loss(x, key):
+        return 0.5 * jnp.sum(x * x) + 0.01 * jax.random.normal(key, ())
+
+    c = conf(iters=6, lr=0.05)
+    x0 = jnp.ones((4,), jnp.float32)
+    key = jax.random.PRNGKey(7)
+    terms = [EpsTermination(eps=1e-30)]
+    xe, se = run_eager(cls, c, noisy_loss, x0, terms, key=key)
+    xl, sl = run_loop(cls, c, noisy_loss, x0, terms, key=key)
+    np.testing.assert_allclose(np.asarray(xl), np.asarray(xe),
+                               rtol=1e-5, atol=1e-6)
+    assert float(sl) == pytest.approx(float(se), rel=1e-5)
+
+
+def _stop_iteration_eager(cls, c, loss, x0, terminations):
+    """Run eager and report (params, score, iterations_run)."""
+    spy = _EagerSpy()
+    # spy FIRST: any() short-circuits, so a later spy would miss the
+    # check on which a real termination fires
+    opt = cls(c, loss, terminations=[spy] + list(terminations))
+    opt._has_device_loop = lambda: False   # force the eager Python loop
+    params, score = opt.optimize(jnp.array(x0, copy=True))
+    return params, score, len(spy.calls)
+
+
+def test_norm2_stop_iteration_matches():
+    """Norm2Termination fires at a definite mid-run iteration (tolerance
+    chosen between two successive gnorms): if the loop paired gnorm with
+    the wrong score pair or checked one step early/late, the final params
+    would differ by one SGD update."""
+    c = conf(iters=40, lr=0.1)
+    x0 = jnp.full((3,), 2.0, jnp.float32)
+    # under x <- 0.9 x, gnorm_i = |x0|*0.9^i; pick tol between i=6 and i=7
+    gn = float(jnp.linalg.norm(x0))
+    tol = gn * 0.9**6.5
+    terms = [Norm2Termination(gradient_tolerance=tol)]
+    xe, se, iters = _stop_iteration_eager(
+        IterationGradientDescent, c, quad_loss, x0, terms)
+    assert 0 < iters < 40, "tolerance must stop the run mid-way"
+    xl, sl = run_loop(IterationGradientDescent, c, quad_loss, x0, terms)
+    np.testing.assert_allclose(np.asarray(xl), np.asarray(xe),
+                               rtol=1e-6, atol=0)
+    assert float(sl) == pytest.approx(float(se), rel=1e-6)
+
+
+def test_eps_stop_iteration_matches():
+    """EpsTermination on a converging run stops both paths at the same
+    iteration (same relative-change series on both sides). The constant
+    offset makes the RELATIVE score change decay (on a pure quadratic
+    under SGD it is constant, so eps would either fire at the first
+    legal check or never)."""
+
+    def offset_quad(x):
+        return 0.5 * jnp.sum(x * x) + 1.0
+
+    c = conf(iters=60, lr=0.1)
+    x0 = jnp.asarray([1.5, -2.0, 0.5], jnp.float32)
+    terms = [EpsTermination(eps=2e-2)]
+    xe, se, iters = _stop_iteration_eager(
+        IterationGradientDescent, c, offset_quad, x0, terms)
+    assert 1 < iters < 60, "eps must stop the run mid-way"
+    xl, sl = run_loop(IterationGradientDescent, c, offset_quad, x0, terms)
+    np.testing.assert_allclose(np.asarray(xl), np.asarray(xe),
+                               rtol=1e-6, atol=0)
+    assert float(sl) == pytest.approx(float(se), rel=1e-6)
+
+
+def test_zero_direction_sentinel_guard():
+    """The loop carry is initialized with gnorm=0.0 — exactly
+    ZeroDirection's firing condition. Without the (i == 0) guard the loop
+    would terminate before running ANY step; the eager path always runs
+    at least one. Use a nonzero-gradient loss so a premature stop is
+    visible in the params."""
+    c = conf(iters=5, lr=0.1)
+    x0 = jnp.ones((4,), jnp.float32)
+    terms = [ZeroDirection()]
+    xe, se = run_eager(IterationGradientDescent, c, quad_loss, x0, terms)
+    xl, sl = run_loop(IterationGradientDescent, c, quad_loss, x0, terms)
+    assert not np.allclose(np.asarray(xl), np.asarray(x0)), \
+        "loop terminated on the init sentinel without stepping"
+    np.testing.assert_allclose(np.asarray(xl), np.asarray(xe), rtol=1e-6)
+
+
+def test_eps_sentinel_inf_scores_do_not_fire():
+    """At i == 0 the carry scores are (inf, inf): a naive relative-change
+    formula gives 0/inf or nan; the finite guard (mirroring the eager
+    EpsTermination's isfinite check) must not fire. A tight eps would
+    stop immediately if the guard were wrong."""
+    c = conf(iters=5, lr=0.1)
+    x0 = jnp.ones((4,), jnp.float32)
+    terms = [EpsTermination(eps=1e30)]  # fires at the FIRST legal check
+    xe, se, iters = _stop_iteration_eager(
+        IterationGradientDescent, c, quad_loss, x0, terms)
+    # eager: runs step 0, then check (score0, inf) -> isfinite guard says
+    # False; runs step 1, check (score1, score0) -> fires. 2 iterations.
+    assert iters == 2
+    xl, sl = run_loop(IterationGradientDescent, c, quad_loss, x0, terms)
+    np.testing.assert_allclose(np.asarray(xl), np.asarray(xe), rtol=1e-6)
+    assert float(sl) == pytest.approx(float(se), rel=1e-6)
+
+
+def test_gnorm_score_pairing_no_lag():
+    """Discriminates the exact (score_i, score_{i-1}, gnorm_i) triple:
+    every eager termination check must see the SAME triple the traced
+    cond sees. The spy records the eager triples; replaying them through
+    _terminate_traced must agree check-for-check."""
+    c = conf(iters=6, lr=0.1)
+    x0 = jnp.asarray([2.0, -1.0], jnp.float32)
+    spy = _EagerSpy()
+    opt = IterationGradientDescent(c, quad_loss, terminations=[spy])
+    opt._has_device_loop = lambda: False   # force the eager Python loop
+    opt.optimize(jnp.array(x0, copy=True))
+    assert len(spy.calls) == 6
+    # traced predicate, evaluated on the recorded eager triples, must
+    # reproduce the eager trio's verdicts exactly
+    ref = IterationGradientDescent(
+        c, quad_loss,
+        terminations=[EpsTermination(eps=2e-2), ZeroDirection(),
+                      Norm2Termination(gradient_tolerance=1.0)])
+    eager_terms = ref.terminations
+    for new, old, gn in spy.calls:
+        traced = bool(ref._terminate_traced(
+            jnp.float32(new), jnp.float32(old), jnp.float32(gn)))
+        eager = any(t.terminate(new, old, gn) for t in eager_terms)
+        assert traced == eager, (new, old, gn)
+
+
+def test_listeners_force_eager_path():
+    """Per-iteration listeners need host callbacks — the loop must not
+    be selected when any listener is attached."""
+    from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+
+    c = conf(iters=4)
+    opt = IterationGradientDescent(c, quad_loss,
+                                   listeners=[ScoreIterationListener(1)])
+    assert not opt._device_loop_eligible()
+    params, score = opt.optimize(jnp.ones((3,), jnp.float32))
+    assert isinstance(score, float)  # eager path returns a synced float
+
+
+def test_custom_termination_forces_eager_path():
+    class Weird(TerminationCondition):
+        def terminate(self, new_score, old_score, grad_norm):
+            return False
+
+    c = conf(iters=4)
+    opt = IterationGradientDescent(c, quad_loss, terminations=[Weird()])
+    assert not opt._device_loop_eligible()
+
+
+def test_single_iteration_skips_loop():
+    c = conf(iters=1)
+    opt = IterationGradientDescent(c, quad_loss)
+    params, score = opt.optimize(jnp.ones((3,), jnp.float32))
+    assert isinstance(score, float)
+
+
+def test_loop_cache_invalidates_on_conf_or_termination_change():
+    """Mutating num_iterations or the termination list between
+    optimize() calls must recompile the loop (both are baked into the
+    trace), not silently reuse the stale one."""
+    c = conf(iters=4, lr=0.1)
+    opt = IterationGradientDescent(c, quad_loss,
+                                   terminations=[EpsTermination(1e-30)])
+    x4, _ = opt.optimize(jnp.ones((3,), jnp.float32))
+    first_loop = opt._loop
+    opt.conf.num_iterations = 8
+    x8, _ = opt.optimize(jnp.ones((3,), jnp.float32))
+    assert opt._loop is not first_loop
+    # the recompiled loop must match an eager run at the NEW iteration
+    # count (a stale 4-iteration loop would stop early)
+    xe, _ = run_eager(IterationGradientDescent, conf(iters=8, lr=0.1),
+                      quad_loss, jnp.ones((3,), jnp.float32),
+                      [EpsTermination(1e-30)])
+    np.testing.assert_allclose(np.asarray(x8), np.asarray(xe), rtol=1e-5)
+    assert not np.allclose(np.asarray(x8), np.asarray(x4)), \
+        "8-iteration rerun reused the stale 4-iteration loop"
+    # tightening a termination's constant must also recompile
+    second_loop = opt._loop
+    opt.terminations = [Norm2Termination(gradient_tolerance=10.0)]
+    x_stop, _ = opt.optimize(jnp.ones((3,), jnp.float32))
+    assert opt._loop is not second_loop
+    # gnorm of ones is sqrt(3) < 10: stops after the first step
+    xe1, _ = run_eager(IterationGradientDescent, conf(iters=8, lr=0.1),
+                       quad_loss, jnp.ones((3,), jnp.float32),
+                       [Norm2Termination(gradient_tolerance=10.0)])
+    np.testing.assert_allclose(np.asarray(x_stop), np.asarray(xe1),
+                               rtol=1e-5)
+
+
+def test_loop_used_in_pretrain_path():
+    """Layer-wise pretraining (the dbn bench path) must actually select
+    the device loop: no listeners + default terminations + iters > 1."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    c = (NeuralNetConfiguration.builder()
+         .lr(0.05).n_in(12).activation_function("sigmoid")
+         .optimization_algo("iteration_gradient_descent")
+         .num_iterations(3)
+         .list(2).hidden_layer_sizes([8])
+         .override(1, layer="output", loss_function="mcxent",
+                   activation_function="softmax", n_out=3)
+         .pretrain(True)
+         .override(0, layer="rbm", k=1)
+         .build())
+    net = MultiLayerNetwork(c)
+    x = jnp.asarray(np.random.RandomState(0).rand(16, 12), jnp.float32)
+    net.pretrain(x)
+    solver = net._pretrain_solvers[0]
+    opt = solver.get_optimizer()
+    assert opt._device_loop_eligible()
+    assert getattr(opt, "_loop", None) is not None, \
+        "pretrain did not take the device-loop path"
