@@ -71,10 +71,15 @@ def _causal_branches(causal: bool, qi, ki, q_tile: int, block_k: int,
     return visible, diagonal
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, s_ref, *,
-            causal: bool, q_tile: int, block_k: int, causal_offset: int):
+def _kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal: bool, q_tile: int,
+            block_k: int, causal_offset: int, group: int, want_lse: bool):
     from jax.experimental import pallas as pl
 
+    if want_lse:
+        lse_ref, acc_ref, m_ref, s_ref = rest
+    else:
+        lse_ref = None
+        acc_ref, m_ref, s_ref = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -90,8 +95,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, s_ref, *,
     # fully-masked rows output 0 like blockwise, unlike naive's
     # mean-of-V). Blocks entirely BELOW the diagonal take the mask-free
     # branch: the per-block iota/compare/where VPU work only runs on
-    # diagonal-crossing blocks, and at these tile sizes the VPU softmax
-    # — not the MXU — is the kernel's bottleneck.
+    # diagonal-crossing blocks.
     visible, diagonal = _causal_branches(
         causal, qi, ki, q_tile, block_k, causal_offset)
 
@@ -102,19 +106,25 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, s_ref, *,
         # throughput. Softmax state is f32 throughout, kept in base-2
         # (scores pre-scaled by log2(e)/sqrt(d), exp2 instead of exp) so
         # the transcendental is a bare exp2 with no hidden multiply.
-        q = q_ref[0]  # (q_tile, d)
-        k = k_ref[0]  # (block_k, d)
-        v = v_ref[0]
+        # `group` batch rows (heads) are processed per grid step as a
+        # batched dot: the round-5 ablation measured the kernel
+        # MXU-dot + per-step-overhead bound (NOT VPU-softmax bound as
+        # round 4's broken-protocol ablation claimed), and halving the
+        # grid-step count amortizes that overhead (0.547 -> 0.462 ms at
+        # 4x8x2048x64 with group=2, q_tile=1024).
+        q = q_ref[...]  # (group, q_tile, d)
+        k = k_ref[...]  # (group, block_k, d)
+        v = v_ref[...]
         d = q.shape[-1]
         scale2 = jnp.float32(LOG2E) / jnp.float32(d) ** 0.5
         scores = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale2
         if masked:
             q_pos = qi * q_tile + jax.lax.broadcasted_iota(
-                jnp.int32, (q_tile, block_k), 0)
+                jnp.int32, (group, q_tile, block_k), 1)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (q_tile, block_k), 1)
+                jnp.int32, (group, q_tile, block_k), 2)
             mask = k_pos <= q_pos + causal_offset
             scores = jnp.where(mask, scores, NEG_INF)
         m_prev, s_prev = m_ref[...], s_ref[...]
@@ -129,7 +139,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, s_ref, *,
         # flash formulation; accumulation stays f32 so the bf16 rounding
         # of P costs ~2^-8 relative — inside bf16 output tolerance)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
 
     @pl.when(visible)
@@ -143,55 +153,74 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, s_ref, *,
 
     @pl.when(ki == n_k - 1)
     def _finalize():
-        o_ref[0] = (acc_ref[...] /
-                    jnp.maximum(s_ref[...], 1e-30)).astype(o_ref.dtype)
-        # log-sum-exp per row, saved for the backward kernels
-        # (FlashAttention's L = m + log s). Fully-masked rows (s == 0)
-        # get a large sentinel so exp(S - lse) underflows to exactly 0.
-        # Stored lane-broadcast (q_tile, LANES) — Mosaic block shapes
-        # need a 128-divisible trailing dim.
-        s = s_ref[...]
-        # m is tracked in base-2 (see _tile_update); convert to the
-        # natural-log LSE the backward kernels expect: ln2·m + ln(s)
-        lse = jnp.where(s > 0.0,
-                        jnp.float32(LN2) * m_ref[...]
-                        + jnp.log(jnp.maximum(s, 1e-30)),
-                        jnp.float32(-NEG_INF))  # (q_tile, 1)
-        lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], LANES))
+        o_ref[...] = (acc_ref[...] /
+                      jnp.maximum(s_ref[...], 1e-30)).astype(o_ref.dtype)
+        if want_lse:
+            # log-sum-exp per row, saved for the backward kernels
+            # (FlashAttention's L = m + log s). Fully-masked rows (s == 0)
+            # get a large sentinel so exp(S - lse) underflows to exactly
+            # 0. Stored lane-broadcast (group, q_tile, LANES) — Mosaic
+            # block shapes need a 128-divisible trailing dim.
+            s = s_ref[...]
+            # m is tracked in base-2 (see _tile_update); convert to the
+            # natural-log LSE the backward kernels expect: ln2·m + ln(s)
+            lse = jnp.where(s > 0.0,
+                            jnp.float32(LN2) * m_ref[...]
+                            + jnp.log(jnp.maximum(s, 1e-30)),
+                            jnp.float32(-NEG_INF))  # (group, q_tile, 1)
+            lse_ref[...] = jnp.broadcast_to(lse, (*lse.shape[:-1], LANES))
 
 
 def _flash_forward(q, k, v, causal: bool, q_tile: int, block_k: int,
-                   interpret: bool):
+                   interpret: bool, want_lse: bool = True):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, t_q, d = q.shape
     t_k = k.shape[1]
-    grid = (b, t_q // q_tile, t_k // block_k)
-    return pl.pallas_call(
+    # Pair up batch rows (heads) when the batch divides and VMEM allows:
+    # a (2, tile, d) batched dot halves the grid-step count, amortizing
+    # the per-step overhead the round-5 ablation measured (0.547 ->
+    # 0.462 ms at 4x8x2048x64). VMEM guard: the f32 scores value
+    # (group*q_tile*block_k*4B) dominates the ~16 MB scoped budget;
+    # with the lse output block added (the vjp path) group=2 at
+    # 1024x1024 measured 17.7M and OOMed, so the lse path stays group=1
+    # unless the scores tile is <= 4 MB.
+    scores_bytes = q_tile * block_k * 4
+    budget = 4 * 1024 * 1024 if want_lse else 8 * 1024 * 1024
+    group = 2 if (b % 2 == 0 and 2 * scores_bytes <= budget) else 1
+    grid = (b // group, t_q // q_tile, t_k // block_k)
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    out_specs = [pl.BlockSpec((group, q_tile, d),
+                              lambda bi, qi, ki: (bi, qi, 0),
+                              memory_space=pltpu.VMEM)]
+    if want_lse:
+        out_shape.append(
+            jax.ShapeDtypeStruct((b, t_q, LANES), jnp.float32))
+        out_specs.append(pl.BlockSpec((group, q_tile, LANES),
+                                      lambda bi, qi, ki: (bi, qi, 0),
+                                      memory_space=pltpu.VMEM))
+    res = pl.pallas_call(
         partial(_kernel, causal=causal, q_tile=q_tile, block_k=block_k,
-                causal_offset=t_k - t_q),
-        out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
-                   jax.ShapeDtypeStruct((b, t_q, LANES), jnp.float32)),
+                causal_offset=t_k - t_q, group=group, want_lse=want_lse),
+        out_shape=tuple(out_shape) if want_lse else out_shape[0],
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, q_tile, d), lambda bi, qi, ki: (bi, qi, 0),
+            pl.BlockSpec((group, q_tile, d),
+                         lambda bi, qi, ki: (bi, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda bi, qi, ki: (bi, ki, 0),
+            pl.BlockSpec((group, block_k, d),
+                         lambda bi, qi, ki: (bi, ki, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda bi, qi, ki: (bi, ki, 0),
+            pl.BlockSpec((group, block_k, d),
+                         lambda bi, qi, ki: (bi, ki, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=(pl.BlockSpec((1, q_tile, d),
-                                lambda bi, qi, ki: (bi, qi, 0),
-                                memory_space=pltpu.VMEM),
-                   pl.BlockSpec((1, q_tile, LANES),
-                                lambda bi, qi, ki: (bi, qi, 0),
-                                memory_space=pltpu.VMEM)),
+        out_specs=tuple(out_specs) if want_lse else out_specs[0],
         scratch_shapes=[
-            pltpu.VMEM((q_tile, d), jnp.float32),   # acc
-            pltpu.VMEM((q_tile, 1), jnp.float32),   # running max
-            pltpu.VMEM((q_tile, 1), jnp.float32),   # running sum
+            pltpu.VMEM((group, q_tile, d), jnp.float32),   # acc
+            pltpu.VMEM((group, q_tile, 1), jnp.float32),   # running max
+            pltpu.VMEM((group, q_tile, 1), jnp.float32),   # running sum
         ],
         # batch and Q-tile grid dims carry no cross-step state — letting
         # Mosaic treat them as parallel measured ~1.4x on v5e; only the
@@ -200,10 +229,11 @@ def _flash_forward(q, k, v, causal: bool, q_tile: int, block_k: int,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
+    return res if want_lse else (res, None)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal: bool = False, q_tile: int = 512,
+def flash_attention(q, k, v, causal: bool = False, q_tile: int = 1024,
                     block_k: int = 1024, interpret: bool = False):
     """Pallas flash attention. q/k/v: (batch[*heads], T, d). Tile sizes
     fit to T (largest 128-aligned divisor <= the requested tile), so
@@ -212,9 +242,14 @@ def flash_attention(q, k, v, causal: bool = False, q_tile: int = 512,
     interpret=True off-TPU.
 
     Defaults tuned on v5e at (4x8)x2048x64 bf16 causal under the
-    amortized chained-scan protocol (see BASELINE.md): 512/1024 at
-    0.54 ms/step vs 0.60 (256/1024) and 0.66 (512/2048); 1024/1024
-    ties within noise but halves grid parallelism for short sequences.
+    amortized chained-scan protocol (see BASELINE.md). Round-5 ablation:
+    the kernel is MXU-dot + per-grid-step-overhead bound (dots-only on
+    the same grid: 0.50 ms of the 0.63 ms non-causal total; an empty
+    kernel body is 0.11 ms), so fewer/larger steps win: q_tile 1024 +
+    batch-pair grouping (see _flash_forward) moved 0.547 -> 0.462
+    ms/step causal. bf16 softmax, score prescaling, and a
+    double-buffered lookahead pipeline were all measured no-better
+    (scratch/flash_ablate3.py).
 
     NOTE: sequence length is axis -2 (NOT axis 1 — a 4-D (B, H, T, d)
     input's axis 1 is heads; reading it as T silently routed every 4-D
@@ -227,10 +262,14 @@ def flash_attention(q, k, v, causal: bool = False, q_tile: int = 512,
     block_k = _fit_tile(t_k, block_k)
     if q_tile is None or block_k is None:
         return blockwise_attention(q, k, v, causal=causal)
-    out, _lse = _flash_forward(q.reshape(-1, t_q, q.shape[-1]),
-                               k.reshape(-1, t_k, k.shape[-1]),
-                               v.reshape(-1, t_k, v.shape[-1]),
-                               causal, q_tile, block_k, interpret)
+    # primal/inference path: no lse output — skips the extra output
+    # block + finalize log, which is what lets batch-pair grouping fit
+    # VMEM at the 1024x1024 tiles (the vjp fwd below pays for the lse)
+    out, _ = _flash_forward(q.reshape(-1, t_q, q.shape[-1]),
+                            k.reshape(-1, t_k, k.shape[-1]),
+                            v.reshape(-1, t_k, v.shape[-1]),
+                            causal, q_tile, block_k, interpret,
+                            want_lse=False)
     return out.reshape(q.shape)
 
 
@@ -452,7 +491,10 @@ def _bwd(causal, q_tile, block_k, interpret, res, g):
             q, k, v)
         return vjp(g)
     t_q, t_k = q.shape[-2], k.shape[-2]
-    qt = _fit_tile(t_q, q_tile)
+    # the backward kernels keep four (q_tile, block_k) f32 values live
+    # at once (s, p, dp, ds) — cap q_tile at 512 so they fit the ~16 MB
+    # scoped VMEM budget even when the forward ran at 1024
+    qt = _fit_tile(t_q, min(q_tile, 512))
     bk = _fit_tile(t_k, block_k)
     dq, dk, dv = _flash_backward(
         q.reshape(-1, t_q, q.shape[-1]), k.reshape(-1, t_k, k.shape[-1]),

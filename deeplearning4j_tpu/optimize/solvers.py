@@ -179,6 +179,11 @@ class BaseOptimizer:
         round-trip entirely (that sync is the whole cost of layer-wise
         pretraining through a tunneled chip)."""
         x, unravel = ravel_pytree(params)
+        # the jitted step/loop DONATE the params buffer; for single-leaf
+        # pytrees ravel_pytree returns the caller's array itself, so
+        # donate would delete it out from under the caller — hand the
+        # optimizer its own copy (one device op per optimize() call)
+        x = jnp.array(x, copy=True)
         if rng_key is None:
             rng_key = self.rng_key
         base_key = (rng_key if rng_key is not None

@@ -190,7 +190,11 @@ class TestSelfAttentionLayer:
             assert type(layer).__name__ == "SelfAttentionLayer"
         finally:
             sys.modules.update(saved_mods)
-            LAYER_REGISTRY.clear()
+            # merge-restore, never clear: make_layer's lazy import may
+            # have registered OTHER providers (models) during the test;
+            # wiping them would poison later tests in this process —
+            # the providers stay in sys.modules so the lazy re-import
+            # is a no-op and could never re-register them
             LAYER_REGISTRY.update(saved_reg)
 
     def test_registered_and_trains(self):
