@@ -181,14 +181,24 @@ def _flash_forward(q, k, v, causal: bool, q_tile: int, block_k: int,
     # Pair up batch rows (heads) when the batch divides and VMEM allows:
     # a (2, tile, d) batched dot halves the grid-step count, amortizing
     # the per-step overhead the round-5 ablation measured (0.547 ->
-    # 0.462 ms at 4x8x2048x64). VMEM guard: the f32 scores value
-    # (group*q_tile*block_k*4B) dominates the ~16 MB scoped budget;
-    # with the lse output block added (the vjp path) group=2 at
-    # 1024x1024 measured 17.7M and OOMed, so the lse path stays group=1
-    # unless the scores tile is <= 4 MB.
-    scores_bytes = q_tile * block_k * 4
-    budget = 4 * 1024 * 1024 if want_lse else 8 * 1024 * 1024
-    group = 2 if (b % 2 == 0 and 2 * scores_bytes <= budget) else 1
+    # 0.462 ms at 4x8x2048x64). VMEM estimate per grid step at group g:
+    # f32 scores (g*qt*bk*4) + double-buffered bf16 q/k/v/o blocks
+    # (d-scaled) + f32 acc scratch + the lse output block on the vjp
+    # path. The estimate undercounts Mosaic's internal buffers, so the
+    # threshold is CALIBRATED on d=64 1024x1024 measurements: the
+    # no-lse group=2 config (estimate 10.6M) compiles and runs; the lse
+    # group=2 config (estimate 12.7M) OOMs at 17.71M actual against the
+    # 16M scoped limit. 11.5M sits between them, erring conservative
+    # (larger d falls back to the always-safe group=1).
+    def vmem_est(g):
+        scores = g * q_tile * block_k * 4
+        io = 2 * g * (q_tile + 2 * block_k + q_tile) * d * 2  # q,k,v,o x2
+        acc = g * q_tile * d * 4
+        lse = 2 * g * q_tile * LANES * 4 if want_lse else 0
+        return scores + io + acc + lse
+
+    group = 2 if (b % 2 == 0
+                  and vmem_est(2) <= 11.5 * 1024 * 1024) else 1
     grid = (b // group, t_q // q_tile, t_k // block_k)
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
     out_specs = [pl.BlockSpec((group, q_tile, d),

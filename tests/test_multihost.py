@@ -14,9 +14,11 @@ import numpy as np
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = r"""
+import os
 import sys
 proc_id, nprocs, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
                                  sys.argv[3], sys.argv[4])
+local_devices = int(os.environ.get("TEST_LOCAL_DEVICES", "1"))
 import jax
 jax.config.update("jax_platforms", "cpu")
 
@@ -25,25 +27,20 @@ from deeplearning4j_tpu.parallel import multihost
 multihost.initialize(f"127.0.0.1:{port}", nprocs, proc_id)
 info = multihost.process_info()
 assert info["process_count"] == nprocs, info
-assert info["global_devices"] == nprocs, info
+assert info["local_devices"] == local_devices, info
+assert info["global_devices"] == nprocs * local_devices, info
 
 import numpy as np
-from deeplearning4j_tpu.config import NeuralNetConfiguration
 from deeplearning4j_tpu.datasets import ListDataSetIterator
 from deeplearning4j_tpu.datasets.api import DataSet
 from deeplearning4j_tpu.datasets.iris import load_iris
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.parallel import DataParallelTrainer
 
-conf = (NeuralNetConfiguration.builder()
-        .lr(0.1).n_in(4).activation_function("tanh")
-        .optimization_algo("iteration_gradient_descent")
-        .num_iterations(1).use_adagrad(False)
-        .list(2).hidden_layer_sizes([8])
-        .override(1, layer="output", loss_function="mcxent",
-                  activation_function="softmax", n_out=3)
-        .pretrain(False).build())
-net = MultiLayerNetwork(conf)  # same seed in conf => same init everywhere
+# conf single-sourced from the test harness (_iris_conf -> conf.json);
+# same seed in conf => same init everywhere
+with open(f"{outdir}/conf.json") as fh:
+    net = MultiLayerNetwork.from_config_json(fh.read())
 x, y = load_iris()
 x, y = np.asarray(x)[:144], np.asarray(y)[:144]
 
@@ -66,14 +63,19 @@ def _free_port():
     return port
 
 
-def test_two_process_data_parallel_training(tmp_path):
+def _run_workers(tmp_path, extra_env=None, timeout=300):
+    """Spawn two WORKER processes against one coordinator port, kill
+    both on any failure (a dead worker leaves its peer blocked in the
+    distributed barrier forever), and return their saved params."""
     port = _free_port()
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
+    (tmp_path / "conf.json").write_text(_iris_conf().to_json())
     env = dict(os.environ,
                PYTHONPATH=REPO_ROOT + os.pathsep
                + os.environ.get("PYTHONPATH", ""))
-    env.pop("XLA_FLAGS", None)  # no virtual device multiplication here
+    env.pop("XLA_FLAGS", None)  # workers set their own device count
+    env.update(extra_env or {})
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(i), "2", str(port),
@@ -82,14 +84,71 @@ def test_two_process_data_parallel_training(tmp_path):
         for i in range(2)
     ]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=300)
-        outs.append(out.decode())
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, out
-    # gradient psum makes every process's params identical
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out.decode())
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     a = np.load(tmp_path / "params_0.npy")
     b = np.load(tmp_path / "params_1.npy")
+    return a, b
+
+
+def _iris_conf():
+    from deeplearning4j_tpu.config import NeuralNetConfiguration
+
+    return (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(4).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=3)
+            .pretrain(False).build())
+
+
+def test_two_process_data_parallel_training(tmp_path):
+    a, b = _run_workers(tmp_path)
+    # gradient psum makes every process's params identical
     np.testing.assert_allclose(a, b, rtol=1e-6)
     # and training actually moved the params
     assert np.abs(a).sum() > 0
+
+
+def test_two_process_multidevice_mesh_matches_single_process(tmp_path):
+    """2 processes x 4 forced CPU devices = one 8-device global mesh: the
+    training step's gradient psum spans devices both within and ACROSS
+    process boundaries. Asserts (a) both hosts end with identical params
+    and (b) the result matches the SAME trainer run single-process on the
+    full data — the multi-process collective path changes nothing but
+    where the bytes move (reference analog: the akka cluster's averaged
+    model equalling the single-node fit,
+    DeepLearning4jDistributed.java:143-210)."""
+    a, b = _run_workers(
+        tmp_path,
+        extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                   "TEST_LOCAL_DEVICES": "4"})
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    # single-process reference: identical conf/seed/data through the same
+    # trainer on a local mesh (the in-process trainer's equivalence to a
+    # plain sequential fit is pinned in tests/test_parallel.py)
+    from deeplearning4j_tpu.datasets import ListDataSetIterator
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.datasets.iris import load_iris
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel import DataParallelTrainer
+
+    net = MultiLayerNetwork.from_config_json(_iris_conf().to_json())
+    x, y = load_iris()
+    x, y = np.asarray(x)[:144], np.asarray(y)[:144]
+    trainer = DataParallelTrainer(net)
+    trainer.fit(ListDataSetIterator(DataSet(x, y), batch_size=48), epochs=3)
+    ref = np.asarray(net.params())
+    np.testing.assert_allclose(a, ref, rtol=1e-4, atol=1e-6)
