@@ -191,8 +191,9 @@ def _flash_forward(q, k, v, causal: bool, q_tile: int, block_k: int,
     # 16M scoped limit. 11.5M sits between them, erring conservative
     # (larger d falls back to the always-safe group=1).
     def vmem_est(g):
+        itemsize = q.dtype.itemsize  # kernel blocks stay in input dtype
         scores = g * q_tile * block_k * 4
-        io = 2 * g * (q_tile + 2 * block_k + q_tile) * d * 2  # q,k,v,o x2
+        io = 2 * g * (q_tile + 2 * block_k + q_tile) * d * itemsize
         acc = g * q_tile * d * 4
         lse = 2 * g * q_tile * LANES * 4 if want_lse else 0
         return scores + io + acc + lse

@@ -52,6 +52,18 @@ class JobIterator:
     def reset(self) -> None:
         raise NotImplementedError
 
+    def position(self) -> int:
+        """Jobs consumed so far (the resume cursor)."""
+        raise NotImplementedError
+
+    def seek(self, position: int) -> None:
+        """Jump the stream to `position` jobs consumed — how a resumed
+        master skips the work a crashed run already aggregated
+        (checkpoints record it as iterator_position; reference analog:
+        re-reading the HDFS batch offset after ModelSavingActor
+        restore)."""
+        raise NotImplementedError
+
 
 class CollectionJobIterator(JobIterator):
     """Iterate a fixed collection of work items
@@ -78,6 +90,16 @@ class CollectionJobIterator(JobIterator):
         with self._lock:
             self._pos = 0
 
+    def position(self) -> int:
+        with self._lock:
+            return self._pos
+
+    def seek(self, position: int) -> None:
+        if not 0 <= position <= len(self.items):
+            raise ValueError(f"seek({position}) outside 0..{len(self.items)}")
+        with self._lock:
+            self._pos = position
+
 
 class DataSetJobIterator(JobIterator):
     """Wrap a DataSetIterator as a stream of mini-batch jobs (the reference's
@@ -88,6 +110,7 @@ class DataSetJobIterator(JobIterator):
         self.it = dataset_iterator
         self._iter: Optional[Iterator] = None
         self._pending: Optional[Any] = None
+        self._consumed = 0
         self._lock = threading.Lock()
 
     def _ensure(self):
@@ -102,6 +125,7 @@ class DataSetJobIterator(JobIterator):
                 ds, self._pending = self._pending, None
             else:
                 ds = next(self._iter)
+            self._consumed += 1
             return Job(work=ds, worker_id=worker_id)
 
     def has_next(self) -> bool:
@@ -119,7 +143,33 @@ class DataSetJobIterator(JobIterator):
         with self._lock:
             self.it.reset()
             self._iter = iter(self.it)
+            # drop any batch has_next() prefetched from the OLD pass —
+            # leaking it would also put position() off by one, and an
+            # overshooting cursor makes a later resume skip a batch
             self._pending = None
+            self._consumed = 0
+
+    def position(self) -> int:
+        with self._lock:
+            return self._consumed
+
+    def seek(self, position: int) -> None:
+        """Reset the wrapped DataSetIterator and drain `position`
+        batches — batch streams have no random access, so the resume
+        cursor replays the prefix (cheap: host-side iteration only)."""
+        with self._lock:
+            self.it.reset()
+            self._iter = iter(self.it)
+            self._pending = None
+            self._consumed = 0
+            for _ in range(position):
+                try:
+                    next(self._iter)
+                except StopIteration:
+                    raise ValueError(
+                        f"seek({position}) past end of dataset stream"
+                    ) from None
+                self._consumed += 1
 
 
 class WorkerPerformer:

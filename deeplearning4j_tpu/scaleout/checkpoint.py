@@ -163,12 +163,16 @@ class DefaultModelSaver(ModelSaver):
         ))
 
     def save_current(self, params, *, conf_json: Optional[str] = None,
+                     iterator_position: Optional[int] = None,
                      metadata: Optional[Dict[str, Any]] = None) -> str:
         """Checkpoint a packed parameter vector directly — the runtime-level
         save path (DistributedRuntime periodic checkpoints). Loadable by
-        `load_checkpoint` when conf_json is provided."""
+        `load_checkpoint` when conf_json is provided;
+        `iterator_position` is the job-stream resume cursor (same
+        first-class field the network-level save uses)."""
         return self._write(self._payload(
-            conf_json=conf_json, params=params, metadata=metadata))
+            conf_json=conf_json, params=params,
+            iterator_position=iterator_position, metadata=metadata))
 
 
 class UriModelSaver(DefaultModelSaver):

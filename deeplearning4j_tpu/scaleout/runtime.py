@@ -154,6 +154,11 @@ class DistributedRuntime:
         self.aggregator_factory = (aggregator_factory
                                    or ParameterAveragingAggregator)
         self.waves = 0
+        #: jobs pulled from the iterator so far
+        self.jobs_consumed = 0
+        #: updates folded into the published model (one per job); see
+        #: _resume_cursor for how the checkpointed position is derived
+        self.jobs_aggregated = 0
         self._orphan_jobs: List[Job] = []  # evicted workers' in-flight jobs
         # Exact wave membership (reference IterativeReduceWorkRouter.java:46-57
         # barrier): number of jobs dispatched into the current wave. The wave
@@ -167,6 +172,8 @@ class DistributedRuntime:
 
     # ------------------------------------------------------------ lifecycle
     def start_workers(self):
+        if self.workers:  # idempotent: run() also calls this, and two
+            return        # threads sharing one performer would race
         for i, performer in enumerate(self.performers):
             w = _Worker(f"worker-{i}", self.tracker, performer, self.interval,
                         work_retriever=self.work_retriever)
@@ -194,6 +201,7 @@ class DistributedRuntime:
                     job = self.job_iterator.next(wid)
                 except StopIteration:
                     break
+                self.jobs_consumed += 1
             else:
                 break
             if self.work_retriever is not None and job.work is not None:
@@ -303,19 +311,37 @@ class DistributedRuntime:
         for wid in snapshot:
             self.tracker.clear_update(wid)
         self.waves += 1
+        self.jobs_aggregated += len(snapshot)
         if (self.model_saver is not None and self.save_every_waves
                 and self.waves % self.save_every_waves == 0):
             self._save()
 
+    def _resume_cursor(self) -> int:
+        """Job-stream position a resumed master may safely seek() to.
+
+        Never overshoots work that is NOT in the saved params: counts
+        only updates actually folded in (jobs_aggregated) plus jobs
+        finally dropped after retries (re-running those would fail
+        again), capped at jobs pulled — the cap keeps at-least-once
+        duplicates (an evicted worker's late update folding alongside
+        the orphan's redo) from skipping never-trained batches.
+        Undershoot merely re-trains a batch, which parameter averaging
+        tolerates; overshoot would silently lose training data."""
+        dropped = self.tracker.count(JOBS_DROPPED)
+        return int(min(self.jobs_consumed,
+                       self.jobs_aggregated + dropped))
+
     def _save(self):
         """Checkpoint the current averaged model (reference ModelSavingActor
         "save" topic). The saver's save_current gets the packed params plus
-        the conf JSON so the checkpoint is self-describing."""
+        the conf JSON so the checkpoint is self-describing, and the
+        first-class iterator_position resume cursor."""
         conf_json = getattr(self, "conf_json", None)
         if conf_json is None and self.performers:
             conf_json = getattr(self.performers[0], "conf_json", None)
         self.model_saver.save_current(
             self.tracker.get_current(), conf_json=conf_json,
+            iterator_position=self._resume_cursor(),
             metadata={"waves": self.waves})
 
     def _evict_stale(self):

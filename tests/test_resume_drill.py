@@ -1,0 +1,252 @@
+"""Checkpoint/resume drills (VERDICT r4 item 9).
+
+The pieces — npz/Orbax checkpoint tiers (params + updater state +
+iterator position), heartbeat eviction, orphan-job requeue — each have
+unit tests; these drills compose them end-to-end:
+
+1. network-level: a training run is killed mid-stream; a FRESH process
+   (fresh network object) restores params + updater state + iterator
+   position from the checkpoint and continues — final params must equal
+   the uninterrupted run's bit-for-bit (same remaining batch stream,
+   same updater history).
+2. runtime-level: a worker dies mid-run (heartbeats stop -> eviction ->
+   orphan requeue), the master checkpoints each wave and then "crashes";
+   a new master resumes from the checkpoint (params + jobs_consumed
+   seek) and the composed run converges to the uninterrupted run's
+   params.
+
+Reference analog: ModelSavingActor + DefaultModelSaver.java:34-70 (which
+saved params only — updater state and stream position are beyond-parity,
+and exactly what makes these drills assert equality instead of "loss
+went down").
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.datasets.iris import load_iris
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.scaleout.api import CollectionJobIterator
+from deeplearning4j_tpu.scaleout.checkpoint import (DefaultModelSaver,
+                                                    load_checkpoint)
+from deeplearning4j_tpu.scaleout.perform import NeuralNetWorkPerformer
+from deeplearning4j_tpu.scaleout.runtime import DistributedRuntime
+
+
+def _conf(iters=2, momentum=0.5):
+    return (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(4).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(iters).use_adagrad(False).momentum(momentum)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=3)
+            .pretrain(False).build())
+
+
+def _batches(n=8, bs=24, seed=0):
+    x, y = load_iris()
+    x, y = np.asarray(x), np.asarray(y)
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        idx = rng.choice(len(x), bs, replace=False)
+        out.append((x[idx], y[idx]))
+    return out
+
+
+class TestNetworkLevelResume:
+    def test_resume_is_bit_identical_to_uninterrupted(self, tmp_path):
+        batches = _batches()
+        kill_at = 3  # "crash" after batch 3's fit
+
+        # uninterrupted reference
+        ref = MultiLayerNetwork.from_config_json(_conf().to_json())
+        for bx, by in batches:
+            ref.fit(bx, by)
+        ref_params = np.asarray(ref.params())
+
+        # interrupted run: checkpoint (params + updater state + stream
+        # position) at the kill point, then the process "dies"
+        path = str(tmp_path / "mid.ckpt")
+        net = MultiLayerNetwork.from_config_json(_conf().to_json())
+        saver = DefaultModelSaver(path, keep_old=False)
+        for i, (bx, by) in enumerate(batches[:kill_at]):
+            net.fit(bx, by)
+        saver.save(net, iterator_position=kill_at)
+        del net  # the process is gone
+
+        # fresh process: restore and continue the same stream
+        net2, info = load_checkpoint(path)
+        assert info["iterator_position"] == kill_at
+        assert net2._updater_state is not None, \
+            "updater state must survive the checkpoint"
+        for bx, by in batches[info["iterator_position"]:]:
+            net2.fit(bx, by)
+        np.testing.assert_allclose(np.asarray(net2.params()), ref_params,
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_resume_without_updater_state_diverges(self, tmp_path):
+        """Negative control: momentum history matters — restoring params
+        but resetting the updater must NOT reproduce the reference run
+        (this is what the reference's params-only checkpoint lost)."""
+        batches = _batches()
+        ref = MultiLayerNetwork.from_config_json(_conf().to_json())
+        for bx, by in batches:
+            ref.fit(bx, by)
+
+        path = str(tmp_path / "mid.ckpt")
+        net = MultiLayerNetwork.from_config_json(_conf().to_json())
+        for bx, by in batches[:3]:
+            net.fit(bx, by)
+        DefaultModelSaver(path, keep_old=False).save(net,
+                                                     iterator_position=3)
+        net2, _ = load_checkpoint(path)
+        net2._updater_state = None  # simulate params-only restore
+        for bx, by in batches[3:]:
+            net2.fit(bx, by)
+        assert not np.allclose(np.asarray(net2.params()),
+                               np.asarray(ref.params()), rtol=1e-6)
+
+
+def _jobs(n=8, bs=24, seed=1):
+    return [DataSet(bx, by) for bx, by in _batches(n, bs, seed)]
+
+
+def _make_runtime(jobs, ckpt_path=None, initial_params=None, momentum=0.5):
+    from deeplearning4j_tpu.scaleout.statetracker import InMemoryStateTracker
+
+    conf_json = _conf(momentum=momentum).to_json()
+    rt = DistributedRuntime(
+        CollectionJobIterator(jobs),
+        performer_factory=lambda: NeuralNetWorkPerformer(conf_json=conf_json,
+                                                         epochs=1),
+        n_workers=2,
+        # short staleness window so the kill drill's eviction fires
+        # within the test timeout (reference default is 120 s)
+        tracker=InMemoryStateTracker(heartbeat_timeout=0.5),
+        model_saver=(DefaultModelSaver(ckpt_path, keep_old=False)
+                     if ckpt_path else None),
+        save_every_waves=1 if ckpt_path else 0,
+        initial_params=initial_params,
+    )
+    rt.conf_json = conf_json
+    return rt
+
+
+class TestRuntimeLevelDrill:
+    def test_master_crash_resume_is_exact(self, tmp_path):
+        """Clean master crash at a wave boundary: resuming from the
+        checkpoint (params + jobs_consumed seek) reproduces the
+        uninterrupted run EXACTLY — wave composition is deterministic
+        with a fixed worker pool, and within-wave averaging is
+        permutation-invariant. Momentum 0: worker-LOCAL optimizer state
+        is ephemeral by design (the master checkpoint carries the
+        averaged params, as the reference's ModelSavingActor did), so
+        runtime-level exactness holds for stateless updaters; the
+        stateful-updater exactness contract is the network-level drill
+        above, where the checkpoint DOES carry the updater state."""
+        jobs = _jobs(8)
+        ref_params = _make_runtime(list(jobs), momentum=0.0).run(
+            timeout=90.0)
+
+        # the crashed master only got through the first two waves
+        ckpt = str(tmp_path / "run.ckpt")
+        rt1 = _make_runtime(jobs[:4], ckpt_path=ckpt, momentum=0.0)
+        rt1.run(timeout=90.0)
+        assert rt1.jobs_consumed == 4
+
+        net, info = load_checkpoint(ckpt)
+        assert info["iterator_position"] == 4
+        it = CollectionJobIterator(list(jobs))
+        it.seek(info["iterator_position"])
+        rt2 = _make_runtime(list(jobs), momentum=0.0,
+                            initial_params=np.asarray(net.params()))
+        rt2.job_iterator = it
+        resumed = rt2.run(timeout=90.0)
+        np.testing.assert_allclose(resumed, ref_params,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_worker_kill_then_master_crash_then_resume_converges(
+            self, tmp_path):
+        """The full drill: a worker dies mid-run (heartbeats stop ->
+        eviction -> orphan requeue), the master checkpoints each wave
+        then crashes; a new master resumes. The eviction reshapes wave
+        composition (surviving-worker waves are smaller), so the drill
+        asserts LOSS continuity and convergence, not bit equality —
+        parameter averaging under elasticity is trajectory-dependent by
+        design (the reference's Hogwild/averaging modes likewise)."""
+        x, y = load_iris()
+        x, y = np.asarray(x), np.asarray(y)
+        jobs = _jobs(8)
+
+        ref_params = _make_runtime(list(jobs)).run(timeout=90.0)
+        conf_json = _conf().to_json()
+        ref_net = MultiLayerNetwork.from_config_json(conf_json,
+                                                     params=ref_params)
+        ref_loss = ref_net.score(x, y)
+        fresh_loss = MultiLayerNetwork.from_config_json(
+            conf_json).score(x, y)
+
+        # ---- phase 1: worker dies mid-run; master checkpoints every
+        # wave and crashes after the first half of the stream
+        half = jobs[:4]
+        ckpt = str(tmp_path / "run.ckpt")
+        rt1 = _make_runtime(list(half), ckpt_path=ckpt)
+
+        import threading
+        import time
+
+        def _killer():
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if rt1.workers and rt1.workers[0].performed >= 1:
+                    rt1.workers[0].paused.set()
+                    return
+                time.sleep(0.005)
+
+        killer = threading.Thread(target=_killer, daemon=True)
+        killer.start()
+        interrupted = rt1.run(timeout=90.0)
+        killer.join(timeout=5)
+        assert rt1.workers[0].paused.is_set(), "fault was never injected"
+        assert interrupted is not None
+        # the dead worker was evicted yet every job still got consumed
+        assert rt1.jobs_consumed == len(half)
+        ckpt_loss = MultiLayerNetwork.from_config_json(
+            conf_json, params=np.asarray(interrupted)).score(x, y)
+
+        # ---- phase 2: new master resumes from the checkpoint
+        net, info = load_checkpoint(ckpt)
+        assert info["iterator_position"] == len(half)
+        it = CollectionJobIterator(list(jobs))
+        it.seek(info["iterator_position"])
+        rt2 = _make_runtime(list(jobs),
+                            initial_params=np.asarray(net.params()))
+        rt2.job_iterator = it
+        resumed = rt2.run(timeout=90.0)
+        resumed_loss = MultiLayerNetwork.from_config_json(
+            conf_json, params=np.asarray(resumed)).score(x, y)
+
+        # loss continuity: resuming continued training (no regression
+        # past noise) and landed where the uninterrupted run landed
+        assert resumed_loss < fresh_loss, "no training happened"
+        assert resumed_loss <= ckpt_loss + 0.02, \
+            f"resume regressed: {ckpt_loss} -> {resumed_loss}"
+        assert abs(resumed_loss - ref_loss) < 0.1, \
+            f"did not converge to the uninterrupted result: " \
+            f"{resumed_loss} vs {ref_loss}"
+
+    def test_checkpoint_metadata_records_resume_cursor(self, tmp_path):
+        jobs = _jobs(4)
+        ckpt = str(tmp_path / "c.ckpt")
+        rt = _make_runtime(jobs, ckpt_path=ckpt)
+        rt.run(timeout=90.0)
+        assert os.path.exists(ckpt)
+        _, info = load_checkpoint(ckpt)
+        assert info["iterator_position"] == len(jobs)
+        assert info["metadata"]["waves"] == rt.waves
