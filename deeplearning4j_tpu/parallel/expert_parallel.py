@@ -116,13 +116,124 @@ def moe_apply(params, x, mesh: Mesh, axis: str = EXPERT_AXIS,
     )(params, x)
 
 
+def moe_apply_a2a(params, x, mesh: Mesh, axis: str = EXPERT_AXIS,
+                  act: Callable = jnp.tanh,
+                  data_axis: Optional[str] = None,
+                  capacity_factor: float = 1.0,
+                  return_stats: bool = False):
+    """Capacity-factor all-to-all dispatch — the bandwidth-optimal form.
+
+    Where `moe_apply` has every device touch the FULL token batch
+    (dense masked compute, traffic O(N·d) via psum), this variant moves
+    each token ONCE to the device owning its expert and once back:
+    tokens shard over the expert axis (composed with `data_axis` when
+    given), each device packs its local tokens into per-expert buffers
+    of static capacity `ceil(capacity_factor · n_local / n_experts)`,
+    one `all_to_all` delivers them to the owning devices, the local
+    experts run, and a second `all_to_all` returns the outputs to be
+    unpermuted and gate-scaled. Tokens beyond an expert's capacity are
+    DROPPED (output 0) — switch-transformer semantics; with
+    `capacity_factor >= n_experts` capacity covers every local token,
+    nothing can drop, and the result matches `moe_reference` exactly
+    (tested). Overflow rows land in a garbage slot (`cap` index of a
+    cap+1-deep buffer) so they never overwrite kept tokens.
+
+    `return_stats` additionally returns the number of dropped tokens
+    (scalar, summed over all devices).
+    """
+    ep = int(mesh.shape[axis])
+    n_experts = params["W1"].shape[0]
+    if n_experts % ep:
+        raise ValueError(f"{n_experts} experts not divisible by "
+                         f"expert-axis size {ep}")
+    local = n_experts // ep
+    shards = ep * (int(mesh.shape[data_axis]) if data_axis else 1)
+    n_tokens = x.shape[0]
+    if n_tokens % shards:
+        raise ValueError(f"{n_tokens} tokens not divisible by "
+                         f"{shards} token shards")
+    n_loc = n_tokens // shards
+    cap = max(1, int(-(-capacity_factor * n_loc // n_experts)))  # ceil
+
+    def per_device(p, xb):
+        logits = xb @ p["gate"]                      # (n_loc, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        choice = jnp.argmax(logits, axis=-1)         # (n_loc,)
+        prob = jnp.take_along_axis(probs, choice[:, None], 1)[:, 0]
+        # slot of each token within its expert's buffer = its rank among
+        # local tokens choosing the same expert (deterministic,
+        # first-come-first-served like the switch router)
+        onehot = choice[:, None] == jnp.arange(n_experts)[None, :]
+        ranks = jnp.cumsum(onehot, axis=0) - 1       # (n_loc, E)
+        rank = jnp.take_along_axis(ranks, choice[:, None], 1)[:, 0]
+        keep = rank < cap
+        # overflow tokens scatter into the cap-index garbage slot
+        slot = jnp.where(keep, rank, cap)
+        buf = jnp.zeros((n_experts, cap + 1, xb.shape[-1]), xb.dtype)
+        buf = buf.at[choice, slot].set(xb)[:, :cap]  # (E, cap, d)
+
+        # deliver: chunk e of dim 0 goes to expert e's owner; received
+        # row (s·local + j) = what device s packed for my local expert j
+        recv = jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)        # (ep·local, cap, d)
+        recv = recv.reshape(ep, local, cap, -1)
+
+        ys = []
+        for j in range(local):
+            t = recv[:, j].reshape(ep * cap, -1)     # all tokens for my j
+            yj = _expert_ffn(p["W1"][j], p["b1"][j], p["W2"][j],
+                             p["b2"][j], t, act)
+            ys.append(yj.reshape(ep, cap, -1))
+        out_buf = jnp.stack(ys, axis=1)              # (ep, local, cap, d)
+        out_buf = out_buf.reshape(ep * local, cap, -1)
+
+        # return trip: symmetric all_to_all; back[e, c] = my token that
+        # sat in slot c of the buffer I sent toward expert e
+        back = jax.lax.all_to_all(out_buf, axis, split_axis=0,
+                                  concat_axis=0, tiled=True)
+        gathered = back[choice, jnp.clip(slot, 0, cap - 1)]
+        out = jnp.where(keep[:, None], prob[:, None] * gathered, 0.0)
+        if not return_stats:
+            return (out,)
+        # stats cost extra collectives — only when asked for
+        dropped = jax.lax.psum(jnp.sum(~keep), axis)
+        if data_axis:
+            dropped = jax.lax.psum(dropped, data_axis)
+        return out, dropped
+
+    param_specs = {"gate": P(), "W1": P(axis), "b1": P(axis),
+                   "W2": P(axis), "b2": P(axis)}
+    # tokens shard over data x expert (just expert on a 1-D mesh): the
+    # all_to_all runs within each data group's expert peers
+    x_spec = P((data_axis, axis)) if data_axis else P(axis)
+    out_specs = (x_spec, P()) if return_stats else (x_spec,)
+    res = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=out_specs,
+    )(params, x)
+    return res if return_stats else res[0]
+
+
 def moe_grad_step(params, x, y, mesh: Mesh, axis: str = EXPERT_AXIS,
                   lr: float = 0.1, act: Callable = jnp.tanh,
-                  data_axis: Optional[str] = None):
-    """One SGD step on MSE through the expert-parallel block."""
+                  data_axis: Optional[str] = None,
+                  dispatch: str = "dense",
+                  capacity_factor: float = 1.0):
+    """One SGD step on MSE through the expert-parallel block.
+    dispatch: 'dense' (masked psum combine) or 'a2a' (capacity-factor
+    all-to-all)."""
+
+    if dispatch not in ("dense", "a2a"):
+        raise ValueError(f"unknown dispatch {dispatch!r}; "
+                         "expected 'dense' or 'a2a'")
 
     def loss_fn(p):
-        out = moe_apply(p, x, mesh, axis, act, data_axis)
+        if dispatch == "a2a":
+            out = moe_apply_a2a(p, x, mesh, axis, act, data_axis,
+                                capacity_factor=capacity_factor)
+        else:
+            out = moe_apply(p, x, mesh, axis, act, data_axis)
         return jnp.mean((out - y) ** 2)
 
     loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -131,4 +242,4 @@ def moe_grad_step(params, x, y, mesh: Mesh, axis: str = EXPERT_AXIS,
 
 
 __all__ = ["EXPERT_AXIS", "init_moe_params", "moe_reference", "moe_apply",
-           "moe_grad_step"]
+           "moe_apply_a2a", "moe_grad_step"]

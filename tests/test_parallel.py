@@ -366,3 +366,79 @@ class TestExpertParallel:
         mesh = make_mesh({"expert": 4}, devices=jax.devices()[:4])
         with pytest.raises(ValueError, match="divisible"):
             moe_apply(params, x, mesh)
+
+    def test_a2a_matches_reference_at_ample_capacity(self):
+        """capacity_factor = n_experts => per-expert capacity covers
+        every local token, nothing can drop, and the all-to-all
+        dispatch must equal the unsharded reference exactly."""
+        from deeplearning4j_tpu.parallel.expert_parallel import (
+            moe_apply_a2a, moe_reference)
+
+        params, x, _ = self._setup()
+        mesh = make_mesh({"expert": 4}, devices=jax.devices()[:4])
+        out, dropped = moe_apply_a2a(params, x, mesh, capacity_factor=8.0,
+                                     return_stats=True)
+        assert int(dropped) == 0
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(moe_reference(params, x)),
+                                   atol=1e-6)
+
+    def test_a2a_drops_oversubscribed_tokens_and_accounts(self):
+        """Force every token onto expert 0 (rigged gate): with
+        capacity_factor 1 each shard keeps only cap tokens for that
+        expert; the rest are dropped (output 0) and the stats count
+        them exactly."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.parallel.expert_parallel import (
+            moe_apply_a2a, moe_reference)
+
+        params, x, _ = self._setup()
+        rig = dict(params)
+        # all-zero gate => all logits equal => argmax tie-breaks to
+        # index 0 for EVERY token: expert 0 is oversubscribed by
+        # construction (a data-dependent bias could flip sign with x)
+        rig["gate"] = jnp.zeros_like(params["gate"])
+        mesh = make_mesh({"expert": 4}, devices=jax.devices()[:4])
+        n, e = x.shape[0], params["W1"].shape[0]
+        n_loc = n // 4
+        cap = -(-n_loc // e)  # ceil(capacity_factor=1 * n_loc / E)
+        out, dropped = moe_apply_a2a(rig, x, mesh, capacity_factor=1.0,
+                                     return_stats=True)
+        # each of the 4 shards keeps `cap` tokens for expert 0
+        expected_drop = n - 4 * cap
+        assert int(dropped) == expected_drop
+        # kept rows match the reference; dropped rows are exactly zero
+        ref = np.asarray(moe_reference(rig, x))
+        out = np.asarray(out)
+        zero_rows = ~out.any(axis=1)
+        assert zero_rows.sum() == expected_drop
+        np.testing.assert_allclose(out[~zero_rows], ref[~zero_rows],
+                                   atol=1e-6)
+
+    def test_a2a_grad_step_matches_dense_at_ample_capacity(self):
+        from deeplearning4j_tpu.parallel.expert_parallel import (
+            moe_grad_step)
+
+        params, x, y = self._setup()
+        mesh = make_mesh({"expert": 4}, devices=jax.devices()[:4])
+        p1, l1 = moe_grad_step(params, x, y, mesh)
+        p2, l2 = moe_grad_step(params, x, y, mesh, dispatch="a2a",
+                               capacity_factor=8.0)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(p1),
+                        jax.tree_util.tree_leaves(p2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+
+    def test_a2a_ep_x_dp_composes(self):
+        from deeplearning4j_tpu.parallel.expert_parallel import (
+            moe_apply_a2a, moe_reference)
+
+        params, x, _ = self._setup()
+        mesh = make_mesh({"expert": 4, "data": 2})
+        out = moe_apply_a2a(params, x, mesh, data_axis="data",
+                            capacity_factor=8.0)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(moe_reference(params, x)),
+                                   atol=1e-6)
