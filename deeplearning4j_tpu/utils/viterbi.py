@@ -30,33 +30,51 @@ import numpy as np
 def _viterbi_decode(observed: jnp.ndarray, states: int,
                     log_p_correct: float, log_p_incorrect: float,
                     log_stay: float, log_switch: float):
-    """Max-product forward pass with backpointers, then backtrace."""
+    """The 2-parameter smoothing chain, lowered onto the general-table
+    decoder below: uniform init, stay/switch transition matrix, and
+    match/mismatch emissions materialized per frame."""
     trans = jnp.full((states, states), log_switch).at[
         jnp.arange(states), jnp.arange(states)].set(log_stay)
+    init = jnp.full((states,), -math.log(states))
+    emits = jnp.where(observed[:, None] == jnp.arange(states)[None, :],
+                      log_p_correct, log_p_incorrect)
+    return _viterbi_general(init, trans, emits)
 
-    def emission(obs):
-        return jnp.where(jnp.arange(states) == obs,
-                         log_p_correct, log_p_incorrect)
 
-    v0 = emission(observed[0]) - math.log(states)
+@jax.jit
+def _viterbi_general(log_init: jnp.ndarray, log_trans: jnp.ndarray,
+                     log_emits: jnp.ndarray):
+    """General-HMM max-product decode: `log_init` (S,), `log_trans`
+    (S, S) row->col, `log_emits` (T, S) per-frame emission log-probs.
+    Same scan + backtrace machinery as the 2-parameter smoothing chain
+    above, with full tables — the form a trained tagger needs."""
 
-    def step(v_prev, obs):
-        # scores[j, k]: arriving in k from j
-        scores = v_prev[:, None] + trans
+    def step(v_prev, emit):
+        scores = v_prev[:, None] + log_trans
         best_prev = jnp.argmax(scores, axis=0)
-        v = jnp.max(scores, axis=0) + emission(obs)
+        v = jnp.max(scores, axis=0) + emit
         return v, best_prev
 
-    v_final, pointers = jax.lax.scan(step, v0, observed[1:])
+    v0 = log_init + log_emits[0]
+    v_final, pointers = jax.lax.scan(step, v0, log_emits[1:])
     last = jnp.argmax(v_final)
-    best_logp = v_final[last]
 
     def back(state, ptr_row):
         return ptr_row[state], ptr_row[state]
 
     _, rest = jax.lax.scan(back, last, pointers, reverse=True)
-    path = jnp.concatenate([rest, jnp.array([last])])
-    return best_logp, path
+    return v_final[last], jnp.concatenate([rest, jnp.array([last])])
+
+
+def viterbi_path(log_init, log_trans, log_emits) -> Tuple[float, np.ndarray]:
+    """Decode the most likely state path for a general HMM.
+    Returns (best path log-prob, state index sequence)."""
+    log_emits = jnp.asarray(log_emits)
+    if log_emits.ndim != 2 or log_emits.shape[0] == 0:
+        raise ValueError("log_emits must be (frames, states), frames >= 1")
+    logp, path = _viterbi_general(jnp.asarray(log_init),
+                                  jnp.asarray(log_trans), log_emits)
+    return float(logp), np.asarray(path)
 
 
 class Viterbi:

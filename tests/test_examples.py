@@ -16,6 +16,7 @@ EXAMPLES = {
     "word2vec_text.py": None,
     "long_context.py": "max err",
     "distributed_dp.py": "waves",
+    "window_labeling.py": "accuracy",
 }
 
 _DRIVER = """

@@ -1,0 +1,163 @@
+"""Native annotator tests: HMM PoS tagger (PoStagger.java role),
+sentiment lexicon (SWN3.java parity), window labeling
+(ContextLabelRetriever + ContextLabel roles)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.pos import HmmPosTagger
+from deeplearning4j_tpu.nlp.sentiment import (NEGATION_WORDS,
+                                              SentimentLexicon,
+                                              class_for_score)
+from deeplearning4j_tpu.nlp.windows import (annotate_windows,
+                                            string_with_labels, windows)
+from deeplearning4j_tpu.utils.viterbi import viterbi_path
+
+TAGGED = [
+    [("the", "DT"), ("cat", "NN"), ("sat", "VB")],
+    [("a", "DT"), ("dog", "NN"), ("ran", "VB")],
+    [("the", "DT"), ("bird", "NN"), ("sang", "VB")],
+    [("a", "DT"), ("horse", "NN"), ("jumped", "VB")],
+    [("the", "DT"), ("cat", "NN"), ("ran", "VB")],
+]
+
+
+class TestViterbiGeneral:
+    def test_decodes_obvious_path(self):
+        # 2 states; state 0 strongly emits frame 0/2, state 1 frame 1
+        log_init = np.log([0.5, 0.5])
+        log_trans = np.log([[0.5, 0.5], [0.5, 0.5]])
+        emits = np.log([[0.9, 0.1], [0.1, 0.9], [0.9, 0.1]])
+        logp, path = viterbi_path(log_init, log_trans, emits)
+        assert path.tolist() == [0, 1, 0]
+        assert logp == pytest.approx(
+            np.log(0.5) + np.log(0.9) * 3 + np.log(0.5) * 2)
+
+    def test_transitions_break_emission_ties(self):
+        # emissions flat; sticky transitions force a constant path
+        log_init = np.log([0.9, 0.1])
+        log_trans = np.log([[0.9, 0.1], [0.1, 0.9]])
+        emits = np.zeros((4, 2))
+        _, path = viterbi_path(log_init, log_trans, emits)
+        assert path.tolist() == [0, 0, 0, 0]
+
+    def test_rejects_bad_shapes(self):
+        with pytest.raises(ValueError, match="frames"):
+            viterbi_path(np.zeros(2), np.zeros((2, 2)),
+                         np.zeros((0, 2)))
+
+
+class TestHmmPosTagger:
+    def test_tags_seen_sentence(self):
+        t = HmmPosTagger().train(TAGGED)
+        assert t.tag(["the", "dog", "sat"]) == ["DT", "NN", "VB"]
+
+    def test_unknown_word_uses_signature(self):
+        t = HmmPosTagger().train(TAGGED)
+        # 'zebra' unseen: DT _ VB context + <unk> bucket => NN
+        assert t.tag(["the", "zebra", "ran"]) == ["DT", "NN", "VB"]
+        # '-ed' suffix signature learned from singleton 'jumped'
+        assert t.tag(["the", "cat", "walked"]) == ["DT", "NN", "VB"]
+
+    def test_tag_sentence_pairs(self):
+        t = HmmPosTagger().train(TAGGED)
+        assert t.tag_sentence(["a", "cat"]) == [("a", "DT"), ("cat", "NN")]
+
+    def test_retrain_replaces_model(self):
+        t = HmmPosTagger().train(TAGGED)
+        # retrain with a DIFFERENT tag alphabet (4 tags): stale emission
+        # rows from the first corpus must not survive
+        t.train([
+            [("up", "ADV"), ("cat", "NOUN"), ("sat", "VERB")],
+            [("down", "ADV"), ("dog", "NOUN"), ("ran", "VERB"),
+             ("fast", "ADJ")],
+        ])
+        assert t.tag(["up", "cat", "sat"]) == ["ADV", "NOUN", "VERB"]
+        # 'the' was only in the FIRST corpus: must fall back, not crash
+        assert len(t.tag(["the", "cat"])) == 2
+
+    def test_empty_and_untrained(self):
+        t = HmmPosTagger().train(TAGGED)
+        assert t.tag([]) == []
+        with pytest.raises(RuntimeError, match="untrained"):
+            HmmPosTagger().tag(["x"])
+        with pytest.raises(ValueError, match="2 distinct"):
+            HmmPosTagger().train([[("a", "X")]])
+
+
+class TestSentimentLexicon:
+    def test_score_and_negation_flip(self):
+        lex = SentimentLexicon({"good": 0.5, "bad": -0.5})
+        assert lex.score_tokens(["good", "movie"]) == pytest.approx(0.5)
+        # SWN3 rule: ANY negation word flips the whole sentence score
+        assert lex.score_tokens(["not", "good"]) == pytest.approx(-0.5)
+        assert "not" in NEGATION_WORDS
+
+    def test_class_bands_are_monotone(self):
+        series = [1.0, 0.5, 0.1, 0.0, -0.1, -0.5, -1.0]
+        names = [class_for_score(s) for s in series]
+        assert names == ["strong_positive", "positive", "weak_positive",
+                         "neutral", "weak_negative", "negative",
+                         "strong_negative"]
+
+    def test_sentiwordnet_parse_harmonic_weighting(self, tmp_path):
+        # word 'fine' with senses rank1 (pos .5) and rank3 (neg -.25):
+        # score = (.5/1 + (-.25)/3) / (1 + 1/2 + 1/3)  — the reference
+        # normalizes over ALL slots up to max rank (gap rank2 counts)
+        p = tmp_path / "swn.txt"
+        p.write_text(
+            "# comment line\n"
+            "a\t001\t0.5\t0.0\tfine#1\n"
+            "a\t002\t0.0\t0.25\tfine#3\n"
+            "n\t003\t0.125\t0.0\tdog#1\n"
+            "a\t004\t\t\tskipped#1\n")
+        lex = SentimentLexicon.from_sentiwordnet(str(p))
+        expected = (0.5 / 1 - 0.25 / 3) / (1 + 0.5 + 1 / 3)
+        assert lex.extract("fine") == pytest.approx(expected)
+        assert lex.scores["fine#a"] == pytest.approx(expected)
+        assert lex.extract("dog") == pytest.approx(0.125)
+        assert lex.extract("skipped") == 0.0
+
+
+class TestContextLabels:
+    def test_string_with_labels(self):
+        toks, spans = string_with_labels(
+            "i saw the <LOC> new york </LOC> skyline with <PER> bob </PER>")
+        assert toks == ["i", "saw", "the", "new", "york", "skyline",
+                        "with", "bob"]
+        assert spans == {(3, 5): "LOC", (7, 8): "PER"}
+
+    def test_dashed_and_numbered_labels(self):
+        toks, spans = string_with_labels("go to <B-LOC> paris </B-LOC> now")
+        assert toks == ["go", "to", "paris", "now"]
+        assert spans == {(2, 3): "B-LOC"}
+
+    def test_unbalanced_markup_raises(self):
+        with pytest.raises(ValueError, match="never closed"):
+            string_with_labels("a <X> b")
+        with pytest.raises(ValueError, match="no begin"):
+            string_with_labels("a </X> b")
+        with pytest.raises(ValueError, match="does not match"):
+            string_with_labels("a <X> b </Y>")
+
+    def test_annotate_windows_tags_and_labels(self):
+        t = HmmPosTagger().train(TAGGED)
+        lex = SentimentLexicon({"sang": 0.4})
+        toks, spans = string_with_labels("the <A> bird </A> sang")
+        wins = annotate_windows(toks, 3, tagger=t, lexicon=lex,
+                                span_labels=spans)
+        # precedence: span label wins; the lexicon classifies the rest
+        assert [w.label for w in wins] == ["neutral", "A", "positive"]
+        assert wins[1].focus_tag() == "NN"
+        # tags align through the <s>/</s> padding (pads -> None)
+        assert wins[0].tags == [None, "DT", "NN"]
+        # without span labels the lexicon classifies the window
+        wins2 = annotate_windows(toks, 3, lexicon=lex)
+        assert wins2[2].label == "positive"
+
+    def test_annotate_matches_plain_windows_layout(self):
+        toks = ["a", "b", "c", "d"]
+        plain = windows(toks, 3)
+        annot = annotate_windows(toks, 3)
+        assert [w.words for w in annot] == [w.words for w in plain]
+        assert all(w.label is None for w in annot)
