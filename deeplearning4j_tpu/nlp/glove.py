@@ -152,7 +152,8 @@ class Glove(WordVectors):
         self._params = None
         self._accum = None
         self._triples = None
-        self._rng = None
+        self._device_triples = None
+        self._epoch_key = None
 
     def _epoch_step(self):
         """Build (once) the compiled whole-epoch program: per-batch host
@@ -181,8 +182,27 @@ class Glove(WordVectors):
                 accum)
             return (params, accum), loss
 
+        B = self.batch_size
+
         @jax.jit
-        def epoch(params, accum, rb, cb, xb):
+        def epoch(params, accum, key, rows, cols, vals):
+            # DEVICE-side shuffle: the triples are uploaded once and
+            # stay resident; permuting on device removes the ~MBs of
+            # shuffled index arrays the host used to push through the
+            # tunnel EVERY epoch (that H2D transfer was both the
+            # throughput floor and the dominant noise source of the
+            # glove bench — the tunnel's bandwidth weather varied it by
+            # 4x between consecutive epochs). Shapes are static under
+            # jit, so the pad/tile math is ordinary Python here.
+            n = rows.shape[0]
+            n_pad = (n + B - 1) // B * B
+            perm = jax.random.permutation(key, n)
+            # wrap-around pad (n may be far below one batch)
+            order = perm[jnp.arange(n_pad) % n] if n_pad != n else perm
+            shape = (n_pad // B, B)
+            rb = rows[order].reshape(shape)
+            cb = cols[order].reshape(shape)
+            xb = vals[order].reshape(shape)
             (params, accum), losses = jax.lax.scan(
                 step_core, (params, accum), (rb, cb, xb))
             return params, accum, losses[-1]
@@ -215,7 +235,11 @@ class Glove(WordVectors):
         # per-parameter AdaGrad accumulators (GloveWeightLookupTable parity)
         self._accum = jax.tree_util.tree_map(
             lambda p: jnp.full(p.shape, 1e-8, jnp.float32), self._params)
-        self._rng = np.random.RandomState(self.seed)
+        # distinct stream from the param-init keys (which consumed
+        # split(PRNGKey(seed)) above) — fold_in decorrelates them
+        self._epoch_key = jax.random.fold_in(
+            jax.random.PRNGKey(self.seed), 0x5e)
+        self._device_triples = None  # re-prepare invalidates the cache
         return self
 
     def train_epochs(self, n_epochs: int) -> float:
@@ -228,23 +252,17 @@ class Glove(WordVectors):
             raise ValueError(f"n_epochs must be >= 1, got {n_epochs}")
         rows, cols, vals = self._triples
         epoch = self._epoch_step()
-        n = rows.size
-        B = self.batch_size
-        # pad the shuffled order up to a batch multiple (same tiling the
-        # per-batch path used for its final partial batch)
-        n_pad = (n + B - 1) // B * B
+        # triples uploaded ONCE and cached device-resident; each epoch
+        # only ships a PRNG key (the shuffle runs on device)
+        if self._device_triples is None:
+            self._device_triples = (jnp.asarray(rows), jnp.asarray(cols),
+                                    jnp.asarray(vals))
+        d_rows, d_cols, d_vals = self._device_triples
         loss = None
         for _ in range(n_epochs):
-            order = self._rng.permutation(n)
-            if n_pad != n:
-                order = np.concatenate(
-                    [order, order[np.arange(n_pad - n) % n]])
-            shape = (n_pad // B, B)
+            self._epoch_key, sub = jax.random.split(self._epoch_key)
             self._params, self._accum, loss = epoch(
-                self._params, self._accum,
-                jnp.asarray(rows[order].reshape(shape)),
-                jnp.asarray(cols[order].reshape(shape)),
-                jnp.asarray(vals[order].reshape(shape)))
+                self._params, self._accum, sub, d_rows, d_cols, d_vals)
         syn0 = (np.asarray(self._params["w"])
                 + np.asarray(self._params["c"]))
         WordVectors.__init__(self, self.vocab, syn0)

@@ -3,7 +3,8 @@
 Parity: reference `text/corpora/sentiwordnet/SWN3.java` — a
 SentiWordNet-backed polarity scorer used to label moving-window text:
 per-word score = sense-rank-weighted (pos − neg) average
-(weights 1/(rank+1) normalized by the harmonic sum, SWN3.java:106-118),
+(weight 1/rank, normalized by the harmonic sum over all slots up to
+the max rank, SWN3.java:106-118),
 sentence score = sum of token scores with a sign flip when any negation
 word is present (scoreTokens :174-190), and score -> class bands
 (classForScore :149-165). The UIMA tokenizer plumbing is replaced by
@@ -99,6 +100,8 @@ class SentimentLexicon:
                         r = int(rank)
                     except ValueError:
                         continue
+                    if r < 1:  # rank-0 would divide by zero below;
+                        continue  # skip like other malformed entries
                     per_sense[f"{word}#{pos}"][r] = score
 
         scores: Dict[str, float] = {}

@@ -66,14 +66,45 @@ def _viterbi_general(log_init: jnp.ndarray, log_trans: jnp.ndarray,
     return v_final[last], jnp.concatenate([rest, jnp.array([last])])
 
 
-def viterbi_path(log_init, log_trans, log_emits) -> Tuple[float, np.ndarray]:
+def _viterbi_np(log_init, log_trans, log_emits):
+    """Numpy twin of _viterbi_general for host-side callers: the jitted
+    scan recompiles per (frames, states) shape, and for small tables
+    (PoS tagging natural sentences of every length) the per-length XLA
+    compile dwarfs the decode itself."""
+    T, S = log_emits.shape
+    v = log_init + log_emits[0]
+    pointers = np.empty((T - 1, S), np.int64)
+    for t in range(1, T):
+        scores = v[:, None] + log_trans
+        pointers[t - 1] = scores.argmax(axis=0)
+        v = scores.max(axis=0) + log_emits[t]
+    path = np.empty(T, np.int64)
+    path[-1] = int(v.argmax())
+    for t in range(T - 2, -1, -1):
+        path[t] = pointers[t, path[t + 1]]
+    return float(v.max()), path
+
+
+def viterbi_path(log_init, log_trans, log_emits,
+                 backend: str = "numpy") -> Tuple[float, np.ndarray]:
     """Decode the most likely state path for a general HMM.
-    Returns (best path log-prob, state index sequence)."""
-    log_emits = jnp.asarray(log_emits)
-    if log_emits.ndim != 2 or log_emits.shape[0] == 0:
+    Returns (best path log-prob, state index sequence).
+
+    backend='numpy' (default) runs the host loop — right for small
+    tables at many distinct lengths (each length would trigger a fresh
+    XLA compile); backend='jax' uses the jitted scan — right for long
+    fixed-shape streams."""
+    log_emits_np = np.asarray(log_emits, np.float64)
+    if log_emits_np.ndim != 2 or log_emits_np.shape[0] == 0:
         raise ValueError("log_emits must be (frames, states), frames >= 1")
+    if backend == "numpy":
+        return _viterbi_np(np.asarray(log_init, np.float64),
+                           np.asarray(log_trans, np.float64), log_emits_np)
+    if backend != "jax":
+        raise ValueError(f"unknown backend {backend!r}")
     logp, path = _viterbi_general(jnp.asarray(log_init),
-                                  jnp.asarray(log_trans), log_emits)
+                                  jnp.asarray(log_trans),
+                                  jnp.asarray(log_emits))
     return float(logp), np.asarray(path)
 
 

@@ -41,6 +41,18 @@ class TestViterbiGeneral:
         _, path = viterbi_path(log_init, log_trans, emits)
         assert path.tolist() == [0, 0, 0, 0]
 
+    def test_numpy_and_jax_backends_agree(self):
+        rng = np.random.RandomState(0)
+        for t, s in [(1, 3), (7, 4), (20, 6)]:
+            li, lt, le = rng.randn(s), rng.randn(s, s), rng.randn(t, s)
+            p1, path1 = viterbi_path(li, lt, le, backend="numpy")
+            p2, path2 = viterbi_path(li, lt, le, backend="jax")
+            assert path1.tolist() == path2.tolist()
+            assert p1 == pytest.approx(p2, abs=1e-5)
+        with pytest.raises(ValueError, match="backend"):
+            viterbi_path(np.zeros(2), np.zeros((2, 2)), np.zeros((3, 2)),
+                         backend="torch")
+
     def test_rejects_bad_shapes(self):
         with pytest.raises(ValueError, match="frames"):
             viterbi_path(np.zeros(2), np.zeros((2, 2)),
