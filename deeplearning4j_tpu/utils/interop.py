@@ -1,0 +1,98 @@
+"""Array-ecosystem interchange for DataSets.
+
+Parity: reference `spark/util/MLLibUtil.java` — INDArray <-> MLlib
+Vector/Matrix and DataSet <-> LabeledPoint conversions, the glue that
+let reference models ride another ecosystem's data structures. The
+TPU-native equivalents target the ecosystems on this stack: numpy (the
+host interchange format), torch CPU tensors (the image ships torch),
+jax device arrays, and the (label, features) "labeled point" row form
+(MLLibUtil.toLabeledPoint:129: label = argmax of the one-hot row).
+
+Everything is copy-free where the backends allow it (numpy <-> torch
+share memory via from_numpy/asarray; jax always copies host<->device).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.api import DataSet
+
+
+# ------------------------------------------------------------------ numpy
+def to_numpy(ds: DataSet) -> Tuple[np.ndarray, np.ndarray]:
+    """(features, labels) host arrays (device arrays are fetched)."""
+    return np.asarray(ds.features), np.asarray(ds.labels)
+
+
+def from_numpy(features, labels) -> DataSet:
+    f = np.asarray(features)
+    y = np.asarray(labels)
+    if f.shape[0] != y.shape[0]:
+        raise ValueError(f"features rows {f.shape[0]} != labels rows "
+                         f"{y.shape[0]}")
+    return DataSet(f, y)
+
+
+# ------------------------------------------------------------------- jax
+def to_jax(ds: DataSet):
+    """Device-resident (features, labels)."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(ds.features), jnp.asarray(ds.labels)
+
+
+# ------------------------------------------------------------------ torch
+def to_torch(ds: DataSet):
+    """(features, labels) torch CPU tensors. Sharing is BEST-EFFORT:
+    contiguous host-numpy arrays are wrapped zero-copy
+    (torch.from_numpy), while non-contiguous or device-backed arrays
+    are copied first — mutations through the tensors only reach the
+    DataSet in the zero-copy case."""
+    import torch
+
+    f, y = to_numpy(ds)
+    return (torch.from_numpy(np.ascontiguousarray(f)),
+            torch.from_numpy(np.ascontiguousarray(y)))
+
+
+def from_torch(features, labels) -> DataSet:
+    """DataSet from torch tensors (detached, moved to CPU)."""
+    return from_numpy(features.detach().cpu().numpy(),
+                      labels.detach().cpu().numpy())
+
+
+# ---------------------------------------------------------- labeled points
+def to_labeled_points(ds: DataSet) -> List[Tuple[int, np.ndarray]]:
+    """One (label_index, feature_vector) row per example — the MLlib
+    LabeledPoint form (label = argmax of the one-hot labels row,
+    MLLibUtil.toLabeledPoint:129-138)."""
+    f, y = to_numpy(ds)
+    if y.ndim != 2:
+        raise ValueError("labels must be one-hot (N, classes)")
+    idx = y.argmax(axis=1)
+    return [(int(lab), f[i]) for i, lab in enumerate(idx)]
+
+
+def from_labeled_points(points: Iterable[Tuple[int, Sequence[float]]],
+                        num_labels: int) -> DataSet:
+    """Rebuild a DataSet from (label_index, features) rows
+    (MLLibUtil.fromLabeledPoint:146-170: one-hot at the label index)."""
+    labels, feats = [], []
+    for lab, vec in points:
+        lab = int(lab)
+        if not 0 <= lab < num_labels:
+            raise ValueError(f"label {lab} outside 0..{num_labels - 1}")
+        labels.append(lab)
+        feats.append(np.asarray(vec, np.float32))
+    if not feats:
+        raise ValueError("no labeled points given")
+    f = np.stack(feats)
+    y = np.eye(num_labels, dtype=np.float32)[labels]
+    return DataSet(f, y)
+
+
+__all__ = ["to_numpy", "from_numpy", "to_jax", "to_torch", "from_torch",
+           "to_labeled_points", "from_labeled_points"]

@@ -368,3 +368,67 @@ class TestStringGrid:
         g.dedupe_by_cluster(0)
         assert g.get_column(0) == ["McDonalds", "McDonalds",
                                    "McDonalds", "KFC"]
+
+
+class TestInterop:
+    """MLLibUtil.java parity: DataSet <-> numpy/torch/jax/LabeledPoint."""
+
+    def _ds(self):
+        from deeplearning4j_tpu.datasets.api import DataSet
+        rng = np.random.RandomState(0)
+        f = rng.rand(6, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[[0, 1, 2, 1, 0, 2]]
+        return DataSet(f, y)
+
+    def test_numpy_round_trip(self):
+        from deeplearning4j_tpu.utils import interop
+        ds = self._ds()
+        f, y = interop.to_numpy(ds)
+        ds2 = interop.from_numpy(f, y)
+        np.testing.assert_array_equal(ds2.features, ds.features)
+        np.testing.assert_array_equal(ds2.labels, ds.labels)
+        import pytest
+        with pytest.raises(ValueError, match="rows"):
+            interop.from_numpy(f, y[:3])
+
+    def test_torch_round_trip_shares_memory(self):
+        import torch
+
+        from deeplearning4j_tpu.utils import interop
+        ds = self._ds()
+        tf, ty = interop.to_torch(ds)
+        assert isinstance(tf, torch.Tensor) and tf.shape == (6, 4)
+        ds2 = interop.from_torch(tf, ty)
+        np.testing.assert_array_equal(ds2.features, ds.features)
+        # zero-copy is BEST-EFFORT: it holds for contiguous host numpy
+        # arrays (this case); non-contiguous/device arrays get copied
+        tf[0, 0] = 42.0
+        assert np.asarray(ds.features)[0, 0] == 42.0
+        from deeplearning4j_tpu.datasets.api import DataSet
+        nc = DataSet(np.ones((4, 6), np.float32).T, np.eye(6, 3,
+                                                           dtype=np.float32))
+        tf2, _ = interop.to_torch(nc)
+        tf2[0, 0] = 7.0
+        assert nc.features[0, 0] == 1.0  # copy: no write-through
+
+    def test_jax_device_arrays(self):
+        import jax
+
+        from deeplearning4j_tpu.utils import interop
+        f, y = interop.to_jax(self._ds())
+        assert isinstance(f, jax.Array) and f.shape == (6, 4)
+
+    def test_labeled_points_round_trip(self):
+        import pytest
+
+        from deeplearning4j_tpu.utils import interop
+        ds = self._ds()
+        pts = interop.to_labeled_points(ds)
+        assert [p[0] for p in pts] == [0, 1, 2, 1, 0, 2]
+        ds2 = interop.from_labeled_points(pts, num_labels=3)
+        np.testing.assert_array_equal(ds2.features, ds.features)
+        np.testing.assert_array_equal(ds2.labels, ds.labels)
+        with pytest.raises(ValueError, match="outside"):
+            interop.from_labeled_points([(5, [1.0])], num_labels=3)
+        with pytest.raises(ValueError, match="no labeled points"):
+            interop.from_labeled_points([], num_labels=3)
