@@ -33,10 +33,11 @@ def naive_attention(q, k, v, causal: bool = False, scale: Optional[float] = None
 
 
 @partial(jax.jit, static_argnames=("causal", "block_size", "q_offset",
-                                   "k_offset"))
+                                   "k_offset", "return_lse"))
 def blockwise_attention(q, k, v, causal: bool = False,
                         block_size: int = 512,
-                        q_offset: Optional[int] = None, k_offset: int = 0):
+                        q_offset: Optional[int] = None, k_offset: int = 0,
+                        return_lse: bool = False):
     """Online-softmax attention over KV blocks.
 
     q: (..., Tq, d); k, v: (..., Tk, d). `q_offset`/`k_offset` are the
@@ -45,6 +46,11 @@ def blockwise_attention(q, k, v, causal: bool = False,
     keys up to i + Tk - Tq — the KV-cache decode convention, matching
     `naive_attention`); pass q_offset explicitly for other geometries.
     Fully-masked query rows output zeros.
+
+    `return_lse=True` additionally returns the per-row log-sum-exp of
+    the scaled scores (natural log) — fully-masked rows get the +1e30
+    sentinel the Pallas kernel emits — keeping the O(block) working set
+    (the lse is read off the online-softmax carry, no score matrix).
     """
     orig_dtype = q.dtype
     q = q.astype(jnp.float32)
@@ -101,4 +107,8 @@ def blockwise_attention(q, k, v, causal: bool = False,
     (acc, m, s), _ = lax.scan(
         body, (acc0, m0, s0), (kb, vb, jnp.arange(n_blocks)))
     out = acc / jnp.maximum(s, 1e-30)[..., None]
-    return out.astype(orig_dtype)
+    if not return_lse:
+        return out.astype(orig_dtype)
+    lse = jnp.where(s > 0.0, m + jnp.log(jnp.maximum(s, 1e-30)),
+                    jnp.float32(-NEG_INF))
+    return out.astype(orig_dtype), lse

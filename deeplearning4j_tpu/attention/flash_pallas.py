@@ -413,7 +413,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal: bool, q_tile: int,
-                    block_k: int, interpret: bool):
+                    block_k: int, interpret: bool, lse_grad=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -421,6 +421,10 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, q_tile: int,
     t_k = k.shape[1]
     dd = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                  axis=-1)  # (b, t_q): rowsum(dO ∘ O)
+    if lse_grad is not None:
+        # joint (out, lse) cotangent: d lse/d s_j = p_j, so the lse
+        # term enters ds = p*(dp - dd) as a -g_lse shift of dd
+        dd = dd - lse_grad.astype(jnp.float32)
     dd = jnp.broadcast_to(dd[..., None], (*dd.shape, LANES))
 
     q_spec = pl.BlockSpec((1, q_tile, d), memory_space=pltpu.VMEM)
@@ -475,6 +479,83 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, q_tile: int,
         interpret=interpret,
     )(q, k, v, g, lse, dd)
     return dq, dk, dv
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             q_tile: int = 1024, block_k: int = 1024,
+                             interpret: bool = False):
+    """Flash attention returning (out, lse) — lse[i] = log sum_j
+    exp(s_ij) per query row (natural log, scaled scores). The building
+    block for cross-shard softmax combines (ring attention's per-step
+    merge, flash-decoding style splits): partial results from disjoint
+    KV shards merge exactly via
+    m = max(lse_a, lse_b); out = (exp(lse_a-m) out_a + exp(lse_b-m)
+    out_b) / (exp(lse_a-m) + exp(lse_b-m)).
+
+    CAVEAT: a query row that sees NO keys in its shard (causal split
+    where the whole shard is in the row's future) gets out = 0 and
+    lse = +1e30 — a sentinel, NOT the -inf merge identity. Substitute
+    lse = -inf (and out = 0) for such shards before merging, as
+    attention/ring.py's `future` branch does.
+
+    Differentiable jointly in (out, lse): d lse / d s_j = p_j, so the
+    lse cotangent folds into the existing backward as
+    ds = p * (dp - (rowsum(dO*O) - g_lse)) — i.e. the dd term passed to
+    the dQ/dKV kernels is shifted by -g_lse and nothing else changes.
+    """
+    t_q, t_k = q.shape[-2], k.shape[-2]
+    qt = _fit_tile(t_q, q_tile)
+    bk = _fit_tile(t_k, block_k)
+    if qt is None or bk is None:
+        return _blockwise_with_lse(q, k, v, causal)
+    out3, lse3 = _flash_forward(q.reshape(-1, t_q, q.shape[-1]),
+                                k.reshape(-1, t_k, k.shape[-1]),
+                                v.reshape(-1, t_k, v.shape[-1]),
+                                causal, qt, bk, interpret, want_lse=True)
+    return (out3.reshape(q.shape),
+            lse3[..., 0].reshape(*q.shape[:-1]))
+
+
+def _blockwise_with_lse(q, k, v, causal):
+    """Fallback (out, lse) for kernel-ineligible shapes: the online
+    blockwise scan with its carry's lse read off — O(block) working
+    set, same +1e30 sentinel for fully-masked rows as the kernel."""
+    return blockwise_attention(q, k, v, causal=causal, return_lse=True)
+
+
+def _fwd_with_lse(q, k, v, causal, q_tile, block_k, interpret):
+    out, lse = flash_attention_with_lse(q, k, v, causal, q_tile,
+                                        block_k, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _bwd_with_lse(causal, q_tile, block_k, interpret, res, g):
+    g_out, g_lse = g
+    q, k, v, out, lse = res
+    t_q, t_k = q.shape[-2], k.shape[-2]
+    qt = _fit_tile(t_q, min(q_tile, 512))
+    bk = _fit_tile(t_k, block_k)
+    if qt is None or bk is None:
+        # shapes that fell back in the forward differentiate the
+        # blockwise form (including the lse output)
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _blockwise_with_lse(q_, k_, v_, causal),
+            q, k, v)
+        return vjp((g_out, g_lse))
+    out3 = out.reshape(-1, t_q, q.shape[-1])
+    lse3 = jnp.broadcast_to(
+        lse.reshape(-1, t_q)[..., None], (*lse.reshape(-1, t_q).shape,
+                                          LANES))
+    dq, dk, dv = _flash_backward(
+        q.reshape(-1, t_q, q.shape[-1]), k.reshape(-1, t_k, k.shape[-1]),
+        v.reshape(-1, t_k, v.shape[-1]), out3, lse3,
+        g_out.reshape(-1, t_q, q.shape[-1]), causal, qt, bk, interpret,
+        lse_grad=g_lse.reshape(-1, t_q))
+    return dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape)
+
+
+flash_attention_with_lse.defvjp(_fwd_with_lse, _bwd_with_lse)
 
 
 def _fwd(q, k, v, causal, q_tile, block_k, interpret):

@@ -80,14 +80,88 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     return out.astype(orig_dtype)
 
 
+def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool,
+                                interpret: bool):
+    """Per-device ring body with the Pallas flash kernel computing each
+    visiting shard's local attention on the MXU (bf16 operands, f32
+    state), merged across ring steps in log-space via the kernel's
+    saved per-row lse:
+        m' = max(m, lse_i); acc' = acc·e^(m-m') + out_i·e^(lse_i-m');
+        s' = s·e^(m-m') + e^(lse_i-m');   out = acc/s.
+    Visiting shards entirely in the causal past take the mask-free
+    kernel; the self shard takes the causal kernel; future shards
+    contribute nothing (their branch returns the -inf lse identity) —
+    the same visible/diagonal/skip trichotomy the kernel applies to its
+    own KV blocks, lifted to ring-shard granularity. Gradients flow
+    through the joint (out, lse) custom vjp (the lse cotangent is a dd
+    shift in the backward kernels — flash_pallas.py)."""
+    from deeplearning4j_tpu.attention.flash_pallas import (
+        flash_attention_with_lse)
+
+    n_dev = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    orig_dtype = q.dtype
+
+    def local(k_cur, v_cur, is_causal):
+        out, lse = flash_attention_with_lse(
+            q, k_cur, v_cur, is_causal, interpret=interpret)
+        return out.astype(jnp.float32), lse
+
+    def fold(carry, step):
+        acc, m, s, k_cur, v_cur = carry
+        src_idx = (my_idx - step) % n_dev
+
+        def past(_):      # src < my: every key visible, mask-free kernel
+            return local(k_cur, v_cur, False)
+
+        def diag(_):      # src == my: standard causal within the shard
+            return local(k_cur, v_cur, True)
+
+        def future(_):    # src > my: fully masked — the merge identity
+            z = jnp.zeros(q.shape, jnp.float32)
+            return z, jnp.full(q.shape[:-1], NEG_INF, jnp.float32)
+
+        if causal:
+            out_i, lse_i = lax.cond(
+                src_idx == my_idx, diag,
+                lambda _: lax.cond(src_idx < my_idx, past, future, None),
+                None)
+        else:
+            out_i, lse_i = past(None)
+        m_new = jnp.maximum(m, lse_i)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(lse_i - m_new)
+        acc_new = acc * alpha[..., None] + out_i * beta[..., None]
+        s_new = s * alpha + beta
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        return (acc_new, m_new, s_new,
+                lax.ppermute(k_cur, axis_name, perm),
+                lax.ppermute(v_cur, axis_name, perm)), None
+
+    acc0 = q.astype(jnp.float32) * 0.0
+    row = jnp.sum(q.astype(jnp.float32), axis=-1) * 0.0
+    m0 = row + NEG_INF
+    s0 = row
+    (acc, m, s, _, _), _ = lax.scan(
+        fold, (acc0, m0, s0, k, v), jnp.arange(n_dev))
+    out = acc / jnp.maximum(s, 1e-30)[..., None]
+    return out.astype(orig_dtype)
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
-                   causal: bool = False, batch_axis: Optional[str] = None):
+                   causal: bool = False, batch_axis: Optional[str] = None,
+                   local: str = "einsum", interpret: bool = False):
     """Full attention with Q/K/V sequence-sharded over `axis`.
 
     q, k, v: (batch, T, d) global arrays (T divisible by the axis size).
     Returns (batch, T, d), sequence-sharded the same way. Each ring step
-    processes one visiting shard in a single einsum (per-device shards
-    are already block-sized — the ring IS the blocking).
+    processes one visiting shard (per-device shards are already
+    block-sized — the ring IS the blocking).
+
+    `local` selects the per-step local-attention engine: 'einsum' (f32
+    einsums + explicit online softmax — runs anywhere) or 'flash' (the
+    Pallas flash kernel per visiting shard with log-space lse merging —
+    the MXU path for real TPU pods; set interpret=True off-TPU).
 
     `batch_axis` additionally shards the batch dimension over a second
     mesh axis — the dp×sp composition (each data-parallel replica group
@@ -101,13 +175,34 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     if batch_axis is not None and q.shape[0] % mesh.shape[batch_axis]:
         raise ValueError(f"batch {q.shape[0]} not divisible by mesh "
                          f"axis {batch_axis!r} size {mesh.shape[batch_axis]}")
+    if local == "flash":
+        body = partial(_ring_attention_local_flash, axis_name=axis,
+                       causal=causal, interpret=interpret)
+    elif local == "einsum":
+        body = partial(_ring_attention_local, axis_name=axis,
+                       causal=causal)
+    else:
+        raise ValueError(f"unknown local engine {local!r}; "
+                         "expected 'einsum' or 'flash'")
 
     spec = P(batch_axis, axis, None)
+    kw = {}
+    if local == "flash":
+        # pallas_call's out_shape structs carry no vma annotations, so
+        # the new shard_map's varying-axes checker can't type them —
+        # use its escape hatch (check_vma; check_rep on older jax)
+        import inspect
+        params = inspect.signature(_shard_map).parameters
+        if "check_vma" in params:
+            kw["check_vma"] = False
+        elif "check_rep" in params:  # pre-rename jax
+            kw["check_rep"] = False
     fn = _shard_map(
-        partial(_ring_attention_local, axis_name=axis, causal=causal),
+        body,
         mesh=mesh,
         in_specs=(spec,) * 3,
         out_specs=spec,
+        **kw,
     )
     with mesh:
         return fn(q, k, v)
