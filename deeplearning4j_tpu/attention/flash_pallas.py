@@ -527,6 +527,11 @@ def _blockwise_with_lse(q, k, v, causal):
 def _fwd_with_lse(q, k, v, causal, q_tile, block_k, interpret):
     out, lse = flash_attention_with_lse(q, k, v, causal, q_tile,
                                         block_k, interpret)
+    if (_fit_tile(q.shape[-2], q_tile) is None
+            or _fit_tile(k.shape[-2], block_k) is None):
+        # blockwise-fallback shapes: the backward re-derives everything
+        # via jax.vjp — don't hold the (out, lse) activations alive
+        return (out, lse), (q, k, v, None, None)
     return (out, lse), (q, k, v, out, lse)
 
 
@@ -536,7 +541,7 @@ def _bwd_with_lse(causal, q_tile, block_k, interpret, res, g):
     t_q, t_k = q.shape[-2], k.shape[-2]
     qt = _fit_tile(t_q, min(q_tile, 512))
     bk = _fit_tile(t_k, block_k)
-    if qt is None or bk is None:
+    if out is None or qt is None or bk is None:
         # shapes that fell back in the forward differentiate the
         # blockwise form (including the lse output)
         _, vjp = jax.vjp(

@@ -44,9 +44,7 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
 
     q_pos = my_idx * t_local + jnp.arange(t_local)
 
-    def fold(carry, kv_and_step):
-        acc, m, s, k_cur, v_cur = carry
-        step = kv_and_step
+    def accumulate(acc, m, s, k_cur, v_cur, step):
         src_idx = (my_idx - step) % n_dev  # whose shard we hold this step
         scores = jnp.einsum(
             "...qd,...kd->...qk", q32, k_cur.astype(jnp.float32)) * scale
@@ -60,11 +58,16 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
         s_new = s * alpha + p.sum(axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
             "...qk,...kd->...qd", p, v_cur.astype(jnp.float32))
+        return acc_new, m_new, s_new
+
+    def fold(carry, step):
+        acc, m, s, k_cur, v_cur = carry
+        acc, m, s = accumulate(acc, m, s, k_cur, v_cur, step)
         # rotate K/V to the next device (ring neighbor exchange over ICI)
         perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
-        return (acc_new, m_new, s_new, k_next, v_next), None
+        return (acc, m, s, k_next, v_next), None
 
     # constant-initialized carries must carry the same device-varying axes
     # as the scanned k/v (jax vma rules). Deriving them from q32 inherits
@@ -74,8 +77,14 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     row = jnp.sum(q32, axis=-1) * 0.0
     m0 = row + NEG_INF
     s0 = row
-    (acc, m, s, _, _), _ = lax.scan(
-        fold, (acc0, m0, s0, k, v), jnp.arange(n_dev))
+    # n_dev - 1 fold+rotate steps, then the LAST visiting shard is
+    # consumed without rotating it onward — the final ppermute's output
+    # was a discarded scan carry (one wasted shard-sized ICI exchange
+    # of both K and V per call, plus its transpose under grad)
+    (acc, m, s, k_last, v_last), _ = lax.scan(
+        fold, (acc0, m0, s0, k, v), jnp.arange(n_dev - 1))
+    acc, m, s = accumulate(acc, m, s, k_last, v_last,
+                           jnp.asarray(n_dev - 1))
     out = acc / jnp.maximum(s, 1e-30)[..., None]
     return out.astype(orig_dtype)
 
@@ -107,8 +116,7 @@ def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool,
             q, k_cur, v_cur, is_causal, interpret=interpret)
         return out.astype(jnp.float32), lse
 
-    def fold(carry, step):
-        acc, m, s, k_cur, v_cur = carry
+    def accumulate(acc, m, s, k_cur, v_cur, step):
         src_idx = (my_idx - step) % n_dev
 
         def past(_):      # src < my: every key visible, mask-free kernel
@@ -131,10 +139,14 @@ def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool,
         m_new = jnp.maximum(m, lse_i)
         alpha = jnp.exp(m - m_new)
         beta = jnp.exp(lse_i - m_new)
-        acc_new = acc * alpha[..., None] + out_i * beta[..., None]
-        s_new = s * alpha + beta
+        return (acc * alpha[..., None] + out_i * beta[..., None],
+                m_new, s * alpha + beta)
+
+    def fold(carry, step):
+        acc, m, s, k_cur, v_cur = carry
+        acc, m, s = accumulate(acc, m, s, k_cur, v_cur, step)
         perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
-        return (acc_new, m_new, s_new,
+        return (acc, m, s,
                 lax.ppermute(k_cur, axis_name, perm),
                 lax.ppermute(v_cur, axis_name, perm)), None
 
@@ -142,8 +154,11 @@ def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool,
     row = jnp.sum(q.astype(jnp.float32), axis=-1) * 0.0
     m0 = row + NEG_INF
     s0 = row
-    (acc, m, s, _, _), _ = lax.scan(
-        fold, (acc0, m0, s0, k, v), jnp.arange(n_dev))
+    # as in the einsum body: the last shard is consumed un-rotated
+    (acc, m, s, k_last, v_last), _ = lax.scan(
+        fold, (acc0, m0, s0, k, v), jnp.arange(n_dev - 1))
+    acc, m, s = accumulate(acc, m, s, k_last, v_last,
+                           jnp.asarray(n_dev - 1))
     out = acc / jnp.maximum(s, 1e-30)[..., None]
     return out.astype(orig_dtype)
 
