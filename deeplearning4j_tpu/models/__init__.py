@@ -9,3 +9,8 @@ from deeplearning4j_tpu.models.pretrain import (  # noqa: F401
 )
 from deeplearning4j_tpu.models.conv import ConvolutionDownSampleLayer  # noqa: F401
 from deeplearning4j_tpu.models.lstm import LSTM  # noqa: F401
+from deeplearning4j_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    init_transformer_params,
+    transformer_logits,
+)
