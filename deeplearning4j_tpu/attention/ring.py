@@ -32,9 +32,11 @@ except ImportError:  # pragma: no cover
 from deeplearning4j_tpu.attention.blockwise import NEG_INF
 
 
-def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
-    """Per-device body (inside shard_map). q/k/v: (..., T_local, d)."""
-    n_dev = lax.axis_size(axis_name)
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
+                          n_dev: int):
+    """Per-device body (inside shard_map). q/k/v: (..., T_local, d).
+    `n_dev` is the ring size, passed statically from the mesh (lax has no
+    stable in-trace axis-size query across the jax versions we span)."""
     my_idx = lax.axis_index(axis_name)
     t_local = q.shape[-2]
     d = q.shape[-1]
@@ -53,8 +55,14 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
             mask = k_pos[None, :] <= q_pos[:, None]
             scores = jnp.where(mask, scores, NEG_INF)
         m_new = jnp.maximum(m, scores.max(axis=-1))
-        alpha = jnp.exp(m - m_new)
+        # same sentinel guards as the flash merge below: a row that has
+        # seen only masked keys keeps m == m_new == NEG_INF, where the
+        # unguarded exp()s read as 1 — correct today only because step 0
+        # folds the (never fully masked) diagonal shard first
+        alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
         p = jnp.exp(scores - m_new[..., None])
+        if causal:
+            p = p * mask.astype(jnp.float32)
         s_new = s * alpha + p.sum(axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
             "...qk,...kd->...qd", p, v_cur.astype(jnp.float32))
@@ -90,7 +98,7 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
 
 
 def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool,
-                                interpret: bool):
+                                interpret: bool, n_dev: int):
     """Per-device ring body with the Pallas flash kernel computing each
     visiting shard's local attention on the MXU (bf16 operands, f32
     state), merged across ring steps in log-space via the kernel's
@@ -107,7 +115,6 @@ def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool,
     from deeplearning4j_tpu.attention.flash_pallas import (
         flash_attention_with_lse)
 
-    n_dev = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     orig_dtype = q.dtype
 
@@ -137,8 +144,14 @@ def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool,
         else:
             out_i, lse_i = past(None)
         m_new = jnp.maximum(m, lse_i)
-        alpha = jnp.exp(m - m_new)
-        beta = jnp.exp(lse_i - m_new)
+        # explicit sentinel guards: a fully-masked shard's lse identity
+        # (-1e30) merged while the carry m is still at its -1e30 init
+        # would give exp(0) = 1, silently inflating the denominator.
+        # Folding the diagonal shard first happens to avoid that, but
+        # correctness must not depend on fold order — map the sentinel
+        # to an exact 0 contribution on both sides of the merge.
+        alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
+        beta = jnp.where(lse_i <= NEG_INF / 2, 0.0, jnp.exp(lse_i - m_new))
         return (acc * alpha[..., None] + out_i * beta[..., None],
                 m_new, s * alpha + beta)
 
@@ -192,10 +205,10 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
                          f"axis {batch_axis!r} size {mesh.shape[batch_axis]}")
     if local == "flash":
         body = partial(_ring_attention_local_flash, axis_name=axis,
-                       causal=causal, interpret=interpret)
+                       causal=causal, interpret=interpret, n_dev=n_dev)
     elif local == "einsum":
         body = partial(_ring_attention_local, axis_name=axis,
-                       causal=causal)
+                       causal=causal, n_dev=n_dev)
     else:
         raise ValueError(f"unknown local engine {local!r}; "
                          "expected 'einsum' or 'flash'")

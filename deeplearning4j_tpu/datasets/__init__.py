@@ -10,6 +10,12 @@ from deeplearning4j_tpu.datasets.api import (  # noqa: F401
 from deeplearning4j_tpu.datasets.async_iterator import (  # noqa: F401
     AsyncDataSetIterator,
 )
+from deeplearning4j_tpu.datasets.device_feed import (  # noqa: F401
+    DeviceFeed,
+    FeedBatch,
+    bucket_for,
+    pow2_buckets,
+)
 from deeplearning4j_tpu.datasets.mnist import (  # noqa: F401
     MnistDataSetIterator,
     RawMnistDataSetIterator,

@@ -1,0 +1,230 @@
+"""Device-feed pipeline: shape-bucketed batch padding + async H2D prefetch.
+
+Sits between the DataSetIterators and the train loops. The whole point of
+the TPU-native rewrite is that a training step is one fused XLA program —
+but a raw `fit(DataSetIterator)` run re-specializes that program for every
+distinct batch shape (the ragged last batch of every epoch), and every
+step does a synchronous host->device copy that stalls a sub-millisecond
+chip. This layer fixes both:
+
+1. **Shape bucketing** — ragged batches are zero-padded up to a small
+   fixed set of bucket sizes (powers of two up to the iterator's batch
+   size by default), and the REAL example count rides along as a traced
+   scalar (`FeedBatch.n_valid`). The jitted train step derives a 0/1 row
+   mask from it, so padded rows contribute zero loss/zero gradient and
+   the per-example scaling (loss mean, AdaGrad's ÷batchSize) uses the
+   real count — one compiled program per bucket instead of per shape,
+   with bit-meaningful math.
+
+2. **Async H2D prefetch** — up to `prefetch` upcoming batches are pushed
+   through `jax.device_put` ahead of consumption. `device_put` is
+   asynchronous: the transfer runs on the copy engines while the current
+   step computes. This composes with `AsyncDataSetIterator` (which
+   overlaps host-side batch ASSEMBLY on a producer thread): wrap the
+   source in the async iterator for the host leg, then in a DeviceFeed
+   for the host->device leg.
+
+Masking semantics and the bucketing policy are documented in
+docs/DEVICE_FEED.md.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Iterator, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["FeedBatch", "DeviceFeed", "feed_mask", "pow2_buckets",
+           "bucket_for"]
+
+
+def feed_mask(n_rows: int, n_valid):
+    """(weights, count) for a bucketed batch inside a jitted train step.
+
+    `n_valid` None means an unbucketed batch: no mask, static count —
+    the bit-identical legacy program. Otherwise a traced int32 count
+    yields the 0/1 float32 row mask over `n_rows` padded rows. Every
+    train-step body derives its masking from here so the FeedBatch
+    contract lives in one place."""
+    import jax.numpy as jnp
+
+    if n_valid is None:
+        return None, n_rows
+    return (jnp.arange(n_rows) < n_valid).astype(jnp.float32), n_valid
+
+#: smallest bucket emitted by the default policy — tiny tail batches all
+#: share one program instead of one per size
+DEFAULT_MIN_BUCKET = 8
+
+
+def pow2_buckets(batch_size: int, min_bucket: int = DEFAULT_MIN_BUCKET,
+                 align: int = 1) -> Tuple[int, ...]:
+    """The default bucket ladder: powers of two in [min_bucket,
+    batch_size) plus batch_size itself, each rounded up to a multiple of
+    `align` (the data-parallel replica count). A ragged batch pads to the
+    smallest bucket that holds it, so at most len(buckets) distinct
+    programs ever compile for one iterator's stream."""
+    if batch_size < 1:
+        raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+    buckets = set()
+    b = max(1, min_bucket)
+    while b < batch_size:
+        buckets.add(b)
+        b *= 2
+    buckets.add(batch_size)
+    aligned = {-(-b // align) * align for b in buckets}
+    return tuple(sorted(aligned))
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n; an oversize batch (a source yielding more
+    than its declared batch()) gets a next-power-of-two escape bucket
+    rather than an error — it still bounds program count."""
+    for b in buckets:
+        if b >= n:
+            return b
+    b = max(buckets)
+    while b < n:
+        b *= 2
+    return b
+
+
+class FeedBatch(NamedTuple):
+    """One device-resident training batch.
+
+    `features`/`labels` are padded to a bucket size; `n_valid` is the
+    real example count (int32 scalar). Rows [n_valid:] are zero padding —
+    the train step masks them out of the loss and scales by n_valid, so
+    they never change the math (see MultiLayerNetwork.loss_fn weights).
+    """
+
+    features: Any
+    labels: Any
+    n_valid: Any
+
+    @property
+    def bucket(self) -> int:
+        return int(self.features.shape[0])
+
+
+class DeviceFeed:
+    """Wrap a DataSetIterator into a bucketed, prefetching device stream.
+
+    Iterating a DeviceFeed resets the source and yields FeedBatch tuples
+    whose arrays are already on (or on their way to) the device. Safe to
+    iterate repeatedly — one pass per epoch.
+
+    Parameters
+    ----------
+    source : DataSetIterator (or any object with reset() + iteration
+        yielding DataSet-like (features, labels) pairs).
+    buckets : explicit bucket sizes; default `pow2_buckets(source.batch())`.
+    prefetch : how many upcoming batches to keep in flight through
+        `jax.device_put` (2 = double buffering; 0 disables lookahead).
+    sharding : optional `jax.sharding.Sharding` for features/labels
+        (e.g. `batch_sharding(mesh)` for per-replica feeding); `n_valid`
+        is always placed uncommitted so jit replicates it.
+    align : round every bucket up to a multiple of this (set to the
+        data-parallel replica count so shards stay equal-sized).
+    """
+
+    def __init__(self, source, buckets: Optional[Sequence[int]] = None,
+                 prefetch: int = 2, sharding=None, align: int = 1):
+        if prefetch < 0:
+            raise ValueError(f"prefetch must be >= 0, got {prefetch}")
+        self.source = source
+        if buckets is None:
+            buckets = pow2_buckets(source.batch(), align=align)
+        elif align > 1 and any(b % align for b in buckets):
+            raise ValueError(
+                f"explicit buckets {list(buckets)} must be multiples of "
+                f"align={align}")
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"buckets must be positive, got {buckets}")
+        self.prefetch = prefetch
+        self.sharding = sharding
+        # observability: program-shape behavior is the whole point, so
+        # count what the stream actually did
+        self.bucket_hits = {b: 0 for b in self.buckets}
+        self.padded_examples = 0
+        self.batches = 0
+
+    # ------------------------------------------------------------ padding
+    def _pad(self, ds) -> Tuple[Any, Any, np.int32]:
+        f, l = ds.features, ds.labels
+        n = f.shape[0]
+        b = bucket_for(n, self.buckets)
+        if b not in self.bucket_hits:
+            self.bucket_hits[b] = 0  # escape bucket (oversize source batch)
+        self.bucket_hits[b] += 1
+        self.padded_examples += b - n
+        self.batches += 1
+        if b != n:
+            # host materialization only when padding is actually needed:
+            # a full-bucket batch from a device-resident source passes
+            # through untouched (np.asarray on a jax array would be a
+            # blocking D2H round trip per batch)
+            f, l = np.asarray(f), np.asarray(l)
+            f = np.concatenate(
+                [f, np.zeros((b - n, *f.shape[1:]), f.dtype)])
+            l = np.concatenate(
+                [l, np.zeros((b - n, *l.shape[1:]), l.dtype)])
+        return f, l, np.int32(n)
+
+    def _put(self, padded) -> FeedBatch:
+        import jax
+
+        f, l, n = padded
+        if self.sharding is not None:
+            f = jax.device_put(f, self.sharding)
+            l = jax.device_put(l, self.sharding)
+        else:
+            f = jax.device_put(f)
+            l = jax.device_put(l)
+        # n_valid stays uncommitted: jit replicates it wherever the step
+        # runs (a committed scalar would pin multi-replica programs)
+        return FeedBatch(f, l, jax.device_put(n))
+
+    # ---------------------------------------------------------- streaming
+    def _host_batches(self):
+        self.source.reset()
+        for ds in self.source:
+            yield self._pad(ds)
+
+    def __iter__(self) -> Iterator[FeedBatch]:
+        """One epoch: bucketed batches with up to `prefetch` transfers in
+        flight ahead of the consumer. device_put is async, so filling the
+        lookahead window overlaps the NEXT batches' H2D copies with the
+        current step's compute — no thread needed for the device leg."""
+        host = self._host_batches()
+        window: deque = deque()
+        depth = max(1, self.prefetch)
+        for padded in host:
+            window.append(self._put(padded))
+            if len(window) < depth:
+                continue
+            yield window.popleft()
+        while window:
+            yield window.popleft()
+
+    # --------------------------------------------------- iterator surface
+    def batch(self) -> int:
+        return self.source.batch()
+
+    def reset(self) -> None:
+        self.source.reset()
+
+    def close(self) -> None:
+        close = getattr(self.source, "close", None)
+        if close is not None:
+            close()
+
+    def stats(self) -> dict:
+        """Pipeline counters: how many batches hit each bucket and how
+        many padded (masked-out) rows were shipped."""
+        return {"buckets": list(self.buckets),
+                "bucket_hits": dict(self.bucket_hits),
+                "padded_examples": int(self.padded_examples),
+                "batches": int(self.batches)}

@@ -116,13 +116,16 @@ class LSTM(BaseLayer):
             return jax.vmap(lambda xi: self._scan_sequence(params, xi))(x)
         return self._scan_sequence(params, x)
 
-    def loss(self, params, x, labels, *, rng=None, training: bool = False):
+    def loss(self, params, x, labels, *, rng=None, training: bool = False,
+             weights=None):
         """Sequence loss under the configured loss function; labels
-        (T, n_out) or (B, T, n_out) align with activate()."""
+        (T, n_out) or (B, T, n_out) align with activate(). `weights`
+        (leading dim) masks device-feed padding rows — batched input
+        only, where the leading dim is the example axis."""
         out = self.activate(params, x, rng=rng, training=training)
         if self.conf.loss_function in ("mcxent", "negativeloglikelihood"):
             out = jax.nn.softmax(out, axis=-1)
-        return loss_fn(self.conf.loss_function)(labels, out)
+        return loss_fn(self.conf.loss_function)(labels, out, weights)
 
     # ---------------------------------------------------------- decoding
     def predict(self, params, x_init: jnp.ndarray, ws: jnp.ndarray,

@@ -140,9 +140,12 @@ class OutputLayer(BaseLayer):
     switch (:131-163) is replaced by autodiff over `loss`.
     """
 
-    def loss(self, params, x, labels, *, rng=None, training: bool = False):
+    def loss(self, params, x, labels, *, rng=None, training: bool = False,
+             weights=None):
         """Unregularized data loss; L2 lives in MultiLayerNetwork.loss_fn so
-        it is applied exactly once per layer across all solver paths."""
+        it is applied exactly once per layer across all solver paths.
+        `weights` (per-example, leading dim) masks device-feed padding rows
+        out of the mean — see datasets/device_feed.py."""
         c = self.conf
         out = self.activate(params, x, rng=rng, training=training)
-        return loss_fn(c.loss_function)(labels, out)
+        return loss_fn(c.loss_function)(labels, out, weights)

@@ -26,6 +26,7 @@ import numpy as np
 from jax.flatten_util import ravel_pytree
 
 from deeplearning4j_tpu.config.multi_layer_configuration import MultiLayerConfiguration
+from deeplearning4j_tpu.datasets.device_feed import DeviceFeed, feed_mask
 from deeplearning4j_tpu.nn.api import merge_params
 from deeplearning4j_tpu.nn.layers import make_layer
 from deeplearning4j_tpu.optimize.solver import Solver
@@ -50,7 +51,7 @@ class MultiLayerNetwork:
         self._train_step = None
         self._finetune_solver = None
         self._batch_solver = None
-        self._scan_step = None
+        self._scan_steps: Dict[bool, object] = {}
         self._pretrain_solvers: Dict[int, Solver] = {}
         self._pending_params = params
         self._iteration_count = 0
@@ -88,7 +89,7 @@ class MultiLayerNetwork:
         self._train_step = None
         self._finetune_solver = None
         self._batch_solver = None
-        self._scan_step = None
+        self._scan_steps = {}
         self._pretrain_solvers = {}
         if self._pending_params is not None:
             self.set_parameters(self._pending_params)
@@ -128,12 +129,18 @@ class MultiLayerNetwork:
         return acts
 
     def loss_fn(self, params, x, labels, rng: Optional[jax.Array] = None,
-                training: bool = False):
+                training: bool = False, weights=None):
         """Full-network supervised loss: feed-forward into the output layer's
         configured loss (reference score :1265 via OutputLayer.score), plus
         per-layer L2 (the reference applies L2 per-variable in
         GradientAdjustment.java:66-113; defining it in the loss keeps every
-        solver path — SGD, CG, LBFGS, HF — consistently regularized)."""
+        solver path — SGD, CG, LBFGS, HF — consistently regularized).
+
+        `weights` (per-example over the batch dim) masks device-feed
+        padding rows out of the data loss: zero-weight rows contribute
+        zero loss/gradient and the mean divides by the real count, so
+        shape bucketing never changes the math. None (the default) is the
+        historical unweighted path, bit-identical to before."""
         n = len(self.layers)
         keys = (jax.random.split(rng, 2 * n) if rng is not None
                 else [None] * (2 * n))
@@ -145,7 +152,8 @@ class MultiLayerNetwork:
             cur = self._layer_output(i, cur)
         cur = self._layer_input(n - 1, cur, rng=keys[2 * n - 2])
         score = self.layers[-1].loss(params[str(n - 1)], cur, labels,
-                                     rng=keys[2 * n - 1], training=training)
+                                     rng=keys[2 * n - 1],
+                                     training=training, weights=weights)
         for i, layer in enumerate(self.layers):
             c = layer.conf
             if c.use_regularization and c.l2 > 0:
@@ -200,25 +208,51 @@ class MultiLayerNetwork:
                     cur = self.layers[j].activate(self._params[str(j)], cur)
                     cur = self._layer_output(j, cur)
                 cur = self._layer_input(i, cur)
+                # sync=False: the returned score stays a device scalar —
+                # the per-optimize float() sync is the dominant cost of
+                # layer-wise pretraining through a tunneled chip, and the
+                # lazy %s below only materializes it at INFO verbosity
                 new_params, score = solver.optimize(
-                    self._params[str(i)], cur, rng_key=self.next_key())
+                    self._params[str(i)], cur, rng_key=self.next_key(),
+                    sync=False)
                 self._params[str(i)] = new_params
                 log.info("Pretrained layer %d (score=%s)", i, score)
 
-    def fit(self, x, labels=None, epochs: int = 1) -> None:
+    def fit(self, x, labels=None, epochs: int = 1,
+            device_feed: Optional[bool] = None) -> None:
         """Train. Accepts (x, labels) arrays or a DataSetIterator
         (reference fit(DataSet) :1172 / fit(DataSetIterator) :1021).
         Pretraining (if configured) runs ONCE over the data, then the
-        supervised phase runs for `epochs`."""
+        supervised phase runs for `epochs`.
+
+        Iterator-driven runs go through the device-feed pipeline by
+        default (datasets/device_feed.py): ragged batches are padded to
+        shape buckets with the real count threaded into the masked loss,
+        so the jitted step compiles once per bucket instead of once per
+        batch shape, and H2D transfers prefetch ahead of the step. Pass
+        `device_feed=False` for the legacy per-shape path, or pass a
+        DeviceFeed instance directly as `x` for custom buckets/prefetch.
+        """
         if labels is None:  # iterator protocol
             iterator = x
+            if isinstance(iterator, DeviceFeed):
+                feed, raw = iterator, iterator.source
+            elif device_feed is False:
+                feed, raw = None, iterator
+            else:
+                feed, raw = DeviceFeed(iterator), iterator
             if self.conf.pretrain and self.has_pretrain_layers():
-                self.pretrain(iterator)
+                self.pretrain(raw)
             for _ in range(epochs):
-                iterator.reset()
-                for ds in iterator:
-                    self._fit_supervised(jnp.asarray(ds.features),
-                                         jnp.asarray(ds.labels))
+                if feed is not None:
+                    for fb in feed:
+                        self._fit_supervised(fb.features, fb.labels,
+                                             n_valid=fb.n_valid)
+                else:
+                    iterator.reset()
+                    for ds in iterator:
+                        self._fit_supervised(jnp.asarray(ds.features),
+                                             jnp.asarray(ds.labels))
             return
         x, labels = jnp.asarray(x), jnp.asarray(labels)
         validate_batch(x, labels, n_in=self.layers[0].conf.n_in
@@ -229,13 +263,21 @@ class MultiLayerNetwork:
         for _ in range(epochs):
             self._fit_supervised(x, labels)
 
-    def _fit_supervised(self, x, labels) -> None:
+    def _fit_supervised(self, x, labels, n_valid=None) -> None:
         if self.conf.backprop:
-            self._backprop_fit(x, labels)
+            self._backprop_fit(x, labels, n_valid=n_valid)
         else:
+            if n_valid is not None:
+                # the finetune path is host-driven and per-layer; strip
+                # the bucketing padding instead of threading a mask
+                # through the frozen-feature solver (shape-specialized —
+                # acceptable on this legacy non-backprop path)
+                n = int(n_valid)
+                x, labels = x[:n], labels[:n]
             self.finetune(x, labels)
 
-    def fit_scan(self, x, labels, batch_size: int, epochs: int = 1) -> float:
+    def fit_scan(self, x, labels, batch_size: int, epochs: int = 1,
+                 pad_partial: bool = False) -> float:
         """Whole-epoch training as ONE compiled program: minibatches are
         a leading scan axis and `lax.scan` carries (params, updater
         state) through every step on-device — zero per-step host
@@ -253,8 +295,13 @@ class MultiLayerNetwork:
         Caveat: `epochs` is a static arg — each distinct value compiles
         its own program.
 
-        `x`: (N, features); N is truncated to a multiple of batch_size.
-        Returns the final batch's score."""
+        `x`: (N, features). When N is not a multiple of batch_size the
+        tail is truncated (historical behavior) unless
+        `pad_partial=True`, which zero-pads the last minibatch to
+        batch_size and scans a per-batch example count alongside so the
+        masked loss and the updater's ÷batchSize use the real counts —
+        the device-feed masking semantics (docs/DEVICE_FEED.md), inside
+        the scan. Returns the final batch's score."""
         conf0 = self.layers[-1].conf
         if conf0.optimization_algo.lower() != "iteration_gradient_descent":
             raise ValueError("fit_scan supports iteration_gradient_descent")
@@ -262,34 +309,65 @@ class MultiLayerNetwork:
         validate_batch(x, labels, n_in=self.layers[0].conf.n_in
                        if not self.conf.input_preprocessors.get(0) else None,
                        n_out=self.layers[-1].conf.n_out, context="fit_scan")
+        n_real = x.shape[0]
+        tail = n_real % batch_size
+        if pad_partial and tail:
+            pad = batch_size - tail
+            x = jnp.concatenate(
+                [x, jnp.zeros((pad, *x.shape[1:]), x.dtype)])
+            labels = jnp.concatenate(
+                [labels, jnp.zeros((pad, *labels.shape[1:]), labels.dtype)])
         n = x.shape[0] // batch_size * batch_size
         if n == 0:
             raise ValueError(
                 f"batch_size {batch_size} exceeds {x.shape[0]} examples")
-        xb = x[:n].reshape(n // batch_size, batch_size, *x.shape[1:])
-        yb = labels[:n].reshape(n // batch_size, batch_size,
+        n_batches = n // batch_size
+        xb = x[:n].reshape(n_batches, batch_size, *x.shape[1:])
+        yb = labels[:n].reshape(n_batches, batch_size,
                                 *labels.shape[1:])
+        # no tail -> every count would be batch_size: reuse the cheaper
+        # unmasked program instead of compiling the masked epoch for it
+        masked = bool(pad_partial and tail)
+        counts = None
+        if masked:  # masked implies a nonzero tail
+            counts = np.full((n_batches,), batch_size, np.int32)
+            counts[-1] = tail
+            counts = jnp.asarray(counts)
 
-        if self._scan_step is None:
+        if masked not in self._scan_steps:
             updater = NetworkGradientUpdater.for_network(self)
 
-            @partial(jax.jit, donate_argnums=(0, 1), static_argnums=(4,))
-            def epoch(params, upd_state, xb, yb, n_epochs, rng):
+            @partial(jax.jit, donate_argnums=(0, 1),
+                     static_argnums=(4,) if not masked else (5,))
+            def epoch(params, upd_state, xb, yb, *rest):
+                if masked:
+                    bn, n_epochs, rng = rest
+                else:
+                    n_epochs, rng = rest
+                    bn = None
 
                 def body(carry, batch):
                     params, upd_state, rng = carry
-                    bx, by = batch
+                    if masked:
+                        bx, by, bi = batch
+                        weights, count = feed_mask(bx.shape[0], bi)
+                    else:
+                        bx, by = batch
+                        weights, count = feed_mask(bx.shape[0], None)
                     rng, sub = jax.random.split(rng)
                     score, grads = jax.value_and_grad(self.loss_fn)(
-                        params, bx, by, rng=sub, training=True)
+                        params, bx, by, rng=sub, training=True,
+                        weights=weights)
                     updates, upd_state = updater.update(
-                        grads, upd_state, params, bx.shape[0])
+                        grads, upd_state, params, count)
                     params = jax.tree_util.tree_map(
                         lambda p, u: p - u, params, updates)
                     return (params, upd_state, rng), score
 
+                xs = (xb, yb, bn) if masked else (xb, yb)
+
                 def one_epoch(carry, _):
-                    carry, scores = jax.lax.scan(body, carry, (xb, yb))
+                    carry, scores = jax.lax.scan(body, carry, xs)
                     return carry, scores[-1]
 
                 (params, upd_state, _), last_scores = jax.lax.scan(
@@ -297,26 +375,29 @@ class MultiLayerNetwork:
                     length=n_epochs)
                 return params, upd_state, last_scores[-1]
 
-            self._scan_step = epoch
+            self._scan_steps[masked] = epoch
 
         if self._updater_state is None:
             self._updater_state = NetworkGradientUpdater.for_network(
                 self).init(self._params)
-        self._params, self._updater_state, score = self._scan_step(
-            self._params, self._updater_state, xb, yb, int(epochs),
-            self.next_key())
-        self._iteration_count += epochs * (n // batch_size)
+        args = ((xb, yb, counts, int(epochs)) if masked
+                else (xb, yb, int(epochs)))
+        self._params, self._updater_state, score = self._scan_steps[masked](
+            self._params, self._updater_state, *args, self.next_key())
+        self._iteration_count += epochs * n_batches
         score = float(score)
         for listener in self.listeners:
             listener.iteration_done(self, self._iteration_count - 1, score)
         return score
 
-    def _backprop_fit(self, x, labels) -> None:
+    def _backprop_fit(self, x, labels, n_valid=None) -> None:
         conf0 = self.layers[-1].conf
         algo = conf0.optimization_algo.lower()
         if algo == "iteration_gradient_descent":
             # Hot path: one fused XLA program per step, updater state carried
             # across batches (standard minibatch SGD when num_iterations=1).
+            # n_valid (device-feed path) is a TRACED count — every bucket
+            # shape shares one program regardless of how full it is.
             step = self._get_train_step()
             if self._updater_state is None:
                 self._updater_state = NetworkGradientUpdater.for_network(
@@ -325,7 +406,7 @@ class MultiLayerNetwork:
             for i in range(conf0.num_iterations):
                 self._params, self._updater_state, score = step(
                     self._params, self._updater_state, x, labels,
-                    self.next_key())
+                    self.next_key(), n_valid)
                 self._iteration_count += 1
             for listener in self.listeners:
                 listener.iteration_done(self, self._iteration_count - 1,
@@ -334,9 +415,11 @@ class MultiLayerNetwork:
             if self._batch_solver is None:
                 _, unravel = ravel_pytree(self._params)
 
-                def flat_loss(vec, key, bx, by, *, _u=unravel):
+                def flat_loss(vec, key, bx, by, *rest, _u=unravel):
+                    # rest, when present, is the device-feed row mask
+                    w = rest[0] if rest else None
                     return self.loss_fn(_u(vec), bx, by, rng=key,
-                                        training=True)
+                                        training=True, weights=w)
 
                 # cached: line-search solvers (CG/LBFGS/HF) compile once;
                 # the batch is a traced argument (rng_key at construction
@@ -346,8 +429,11 @@ class MultiLayerNetwork:
                                             listeners=self.listeners,
                                             model=self,
                                             rng_key=self.next_key())
+            data = (x, labels)
+            if n_valid is not None:
+                data += (feed_mask(x.shape[0], n_valid)[0],)
             self._params, _ = self._batch_solver.optimize(
-                self._params, x, labels, rng_key=self.next_key())
+                self._params, *data, rng_key=self.next_key(), sync=False)
 
     def _get_train_step(self):
         if self._train_step is None:
@@ -358,18 +444,36 @@ class MultiLayerNetwork:
             # iteration (~1.4x step throughput on v5e for the MLP config).
             # Callers must treat the passed-in trees as consumed — the fit
             # loop rebinds self._params/_updater_state from the outputs.
+            # n_valid is None (arrays path: bit-identical legacy program)
+            # or a traced int32 count (device-feed path: rows >= n_valid
+            # are bucketing padding, masked out of loss and ÷batchSize).
             @partial(jax.jit, donate_argnums=(0, 1))
-            def step(params, upd_state, x, labels, rng):
+            def step(params, upd_state, x, labels, rng, n_valid=None):
+                weights, count = feed_mask(x.shape[0], n_valid)
                 score, grads = jax.value_and_grad(self.loss_fn)(
-                    params, x, labels, rng=rng, training=True)
+                    params, x, labels, rng=rng, training=True,
+                    weights=weights)
                 updates, upd_state = updater.update(grads, upd_state, params,
-                                                    x.shape[0])
+                                                    count)
                 params = jax.tree_util.tree_map(lambda p, u: p - u, params,
                                                 updates)
                 return params, upd_state, score
 
             self._train_step = step
         return self._train_step
+
+    def train_step_cache_size(self) -> int:
+        """Number of XLA programs compiled for the jitted supervised train
+        step so far — the device-feed recompile counter. With shape
+        bucketing this stays at the number of buckets actually hit (the
+        traced n_valid never re-specializes); without it, one program per
+        distinct batch shape. Returns 0 before the first backprop step."""
+        if self._train_step is None:
+            return 0
+        try:
+            return int(self._train_step._cache_size())
+        except AttributeError:  # pragma: no cover — jax internals moved
+            return -1
 
     def finetune(self, x, labels=None) -> None:
         """Optimize only the output layer on top of frozen features
@@ -399,7 +503,7 @@ class MultiLayerNetwork:
                                            listeners=self.listeners,
                                            model=self)
         new_params, _ = self._finetune_solver.optimize(
-            self._params[out_idx], hidden, jnp.asarray(labels))
+            self._params[out_idx], hidden, jnp.asarray(labels), sync=False)
         self._params[out_idx] = new_params
 
     def _frozen_features(self, x, chunk_size: int = 4096) -> jnp.ndarray:

@@ -11,6 +11,14 @@ number of examples in OutputLayer.score, OutputLayer.java:72-101) and are
 written NaN-safe the way the reference scrubs NaNs via
 `BooleanIndexing.applyWhere(output, isNan, EPS)` (OutputLayer.java:75,:89):
 probabilities are clipped to [EPS, 1-EPS] before logs.
+
+Every loss takes an optional `weights` vector — per-example weights over
+the leading (batch) dimension, used by the device-feed pipeline to mask
+shape-bucketing padding rows out of the mean (datasets/device_feed.py):
+with weights the score is sum(w_i * loss_i) / sum(w), so zero-weight
+(padded) rows contribute nothing to either the value or the gradient and
+the denominator is the REAL example count. `weights=None` keeps the plain
+sum/B path bit-identical to the historical formulas.
 """
 
 from __future__ import annotations
@@ -24,44 +32,60 @@ def _clip(p):
     return jnp.clip(p, EPS, 1.0 - EPS)
 
 
-def mcxent(labels, output):
+def _reduce(pointwise, weights, denom_scale: float = 1.0):
+    """sum(pointwise) / (denom_scale * B), optionally example-weighted.
+
+    The denominator floor only defends the all-masked degenerate batch
+    (0/0 -> 0); fractional weights summing below 1 keep their true
+    sum(w) denominator."""
+    if weights is None:
+        return jnp.sum(pointwise) / (denom_scale * pointwise.shape[0])
+    per_example = jnp.sum(pointwise.reshape(pointwise.shape[0], -1), axis=1)
+    w = weights.astype(per_example.dtype)
+    denom = jnp.maximum(jnp.sum(w), jnp.finfo(per_example.dtype).tiny)
+    return jnp.sum(per_example * w) / (denom_scale * denom)
+
+
+def mcxent(labels, output, weights=None):
     """Multi-class cross entropy: -sum(labels * log(p))."""
-    return -jnp.sum(labels * jnp.log(_clip(output))) / labels.shape[0]
+    return _reduce(-labels * jnp.log(_clip(output)), weights)
 
 
-def xent(labels, output):
+def xent(labels, output, weights=None):
     """Binary cross entropy."""
     p = _clip(output)
-    return -jnp.sum(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p)) / labels.shape[0]
+    return _reduce(-(labels * jnp.log(p)
+                     + (1.0 - labels) * jnp.log(1.0 - p)), weights)
 
 
-def mse(labels, output):
-    return jnp.sum(jnp.square(labels - output)) / (2.0 * labels.shape[0])
+def mse(labels, output, weights=None):
+    return _reduce(jnp.square(labels - output), weights, 2.0)
 
 
-def expll(labels, output):
+def expll(labels, output, weights=None):
     """Exponential log-likelihood (Poisson-style): sum(p - labels*log(p))."""
     p = _clip(output)
-    return jnp.sum(p - labels * jnp.log(p)) / labels.shape[0]
+    return _reduce(p - labels * jnp.log(p), weights)
 
 
-def rmse_xent(labels, output):
-    return jnp.sum(jnp.sqrt(jnp.square(labels - output) + EPS)) / labels.shape[0]
+def rmse_xent(labels, output, weights=None):
+    return _reduce(jnp.sqrt(jnp.square(labels - output) + EPS), weights)
 
 
-def squared_loss(labels, output):
-    return jnp.sum(jnp.square(labels - output)) / labels.shape[0]
+def squared_loss(labels, output, weights=None):
+    return _reduce(jnp.square(labels - output), weights)
 
 
-def negativeloglikelihood(labels, output):
+def negativeloglikelihood(labels, output, weights=None):
     """NLL over softmax output — same functional form as MCXENT here."""
-    return -jnp.sum(labels * jnp.log(_clip(output))) / labels.shape[0]
+    return _reduce(-labels * jnp.log(_clip(output)), weights)
 
 
-def reconstruction_crossentropy(labels, output):
+def reconstruction_crossentropy(labels, output, weights=None):
     """Reconstruction cross-entropy used by pretrain layers (AE/RBM score)."""
     p = _clip(output)
-    return -jnp.sum(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p)) / labels.shape[0]
+    return _reduce(-(labels * jnp.log(p)
+                     + (1.0 - labels) * jnp.log(1.0 - p)), weights)
 
 
 LOSS_FUNCTIONS = {

@@ -60,5 +60,6 @@ class Solver:
                 **self.optimizer_kwargs)
         return self._optimizer
 
-    def optimize(self, params, *data, rng_key=None):
-        return self.get_optimizer().optimize(params, *data, rng_key=rng_key)
+    def optimize(self, params, *data, rng_key=None, sync: bool = True):
+        return self.get_optimizer().optimize(params, *data, rng_key=rng_key,
+                                             sync=sync)

@@ -166,18 +166,20 @@ class BaseOptimizer:
         # a raw _step_fn can't build the traced loop — fall back to eager
         return type(self)._step_fn is not BaseOptimizer._step_fn
 
-    def optimize(self, params, *data, rng_key=None):
+    def optimize(self, params, *data, rng_key=None, sync: bool = True):
         """Run the loop; params is a pytree; returns (params, final_score).
         `data` arrays are forwarded to the loss as traced arguments;
         `rng_key` overrides the construction-time key (fresh stochasticity
         per mini-batch without recompiling).
 
-        When the device loop is taken (no listeners + jittable
-        terminations + num_iterations > 1), `final_score` is a live
-        float32 DEVICE scalar, not a Python float — callers that need the
-        value call float() on it; callers that don't avoid the host
-        round-trip entirely (that sync is the whole cost of layer-wise
-        pretraining through a tunneled chip)."""
+        `sync` controls the return type of `final_score` when the device
+        loop is taken (no listeners + jittable terminations +
+        num_iterations > 1): the default True syncs it to a Python float,
+        so the return type never varies by path; sync=False returns the
+        live float32 DEVICE scalar and skips the host round-trip — that
+        per-optimize sync is the whole cost of layer-wise pretraining
+        through a tunneled chip, so hot internal callers pass
+        sync=False and float() only when they actually read the score."""
         x, unravel = ravel_pytree(params)
         # the jitted step/loop DONATE the params buffer; for single-leaf
         # pytrees ravel_pytree returns the caller's array itself, so
@@ -208,7 +210,7 @@ class BaseOptimizer:
                 done = getattr(listener, "optimization_done", None)
                 if done is not None:  # keep the contract future-proof
                     done(self.model)
-            return unravel(x), score
+            return unravel(x), (float(score) if sync else score)
         if self._step is None:
             self._step = self.make_step()
         step = self._step

@@ -63,8 +63,13 @@ class GradientUpdater:
         return m
 
     def update(self, grads, state: UpdaterState, params,
-               batch_size: int = 1):
-        """Returns (updates, new_state); apply as params -= updates (minimize)."""
+               batch_size=1):
+        """Returns (updates, new_state); apply as params -= updates (minimize).
+
+        `batch_size` may be a Python int (static — the historical path) or
+        a traced int32 scalar (the device-feed pipeline passes the REAL
+        example count of a shape-bucketed batch so the ÷batchSize factor
+        ignores masked padding rows without recompiling per count)."""
         c = self.conf
         it = state.iteration
 
@@ -91,9 +96,16 @@ class GradientUpdater:
         # reference GradientAdjustment ends with gradient.divi(batchSize);
         # with mean losses that only changes the adagrad branch (see module
         # docstring) — divide there, or wherever explicitly requested
-        if (c.use_adagrad or self.divide_by_batch) and batch_size > 1:
-            updates = jax.tree_util.tree_map(
-                lambda u: u / batch_size, updates)
+        if c.use_adagrad or self.divide_by_batch:
+            if isinstance(batch_size, (int, float)):
+                if batch_size > 1:
+                    updates = jax.tree_util.tree_map(
+                        lambda u: u / batch_size, updates)
+            else:  # traced count: divide per-leaf in the leaf's dtype so
+                # bf16 compute nets don't get silently promoted to f32
+                bs = jnp.maximum(batch_size, 1)
+                updates = jax.tree_util.tree_map(
+                    lambda u: u / bs.astype(u.dtype), updates)
 
         return updates, UpdaterState(hist=hist, velocity=velocity,
                                      iteration=it + 1)

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.datasets.device_feed import DeviceFeed, feed_mask
 from deeplearning4j_tpu.optimize.updater import NetworkGradientUpdater
 from deeplearning4j_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -46,15 +47,20 @@ class DataParallelTrainer:
         self._step = self._build_step()
 
     def _step_fn(self):
-        """The shared train-step body; subclasses vary only shardings."""
+        """The shared train-step body; subclasses vary only shardings.
+        `n_valid` is None (legacy pad_batch path — bit-identical program)
+        or a traced int32 real-example count from the device feed: rows
+        >= n_valid are bucketing padding, masked out of the loss and the
+        updater's ÷batchSize."""
         net = self.network
         updater = self.updater
 
-        def step(params, upd_state, x, labels, rng):
+        def step(params, upd_state, x, labels, rng, n_valid=None):
+            weights, count = feed_mask(x.shape[0], n_valid)
             score, grads = jax.value_and_grad(net.loss_fn)(
-                params, x, labels, rng=rng, training=True)
+                params, x, labels, rng=rng, training=True, weights=weights)
             updates, upd_state = updater.update(grads, upd_state, params,
-                                                x.shape[0])
+                                                count)
             params = jax.tree_util.tree_map(lambda p, u: p - u, params, updates)
             return params, upd_state, score
 
@@ -62,10 +68,10 @@ class DataParallelTrainer:
 
     def _step_shardings(self):
         """(in_shardings, out_shardings) for (params, upd_state, x,
-        labels, rng) -> (params, upd_state, score)."""
+        labels, rng, n_valid) -> (params, upd_state, score)."""
         rep = replicated(self.mesh)
         bsh = batch_sharding(self.mesh, self.axis)
-        return (rep, rep, bsh, bsh, rep), (rep, rep, rep)
+        return (rep, rep, bsh, bsh, rep, rep), (rep, rep, rep)
 
     def _build_step(self):
         ins, outs = self._step_shardings()
@@ -91,8 +97,43 @@ class DataParallelTrainer:
             labels = np.concatenate([labels, labels[idx]])
         return x, labels
 
-    def fit(self, iterator, epochs: int = 1) -> None:
+    def _make_feed(self, iterator, device_feed) -> Optional[DeviceFeed]:
+        """The per-replica device feed for fit(): buckets aligned to the
+        data-axis size (equal shards), features/labels device_put with the
+        batch sharding so the H2D transfer lands pre-sharded and
+        prefetches ahead of the step. None = legacy pad_batch path."""
+        if isinstance(iterator, DeviceFeed):
+            bad = [b for b in iterator.buckets if b % self.n_devices]
+            if bad:
+                # fail here with the real constraint, not later with an
+                # opaque GSPMD divisibility error at step dispatch
+                raise ValueError(
+                    f"DeviceFeed buckets {bad} are not multiples of the "
+                    f"data-axis size {self.n_devices}; build the feed "
+                    f"with align={self.n_devices} (or let the trainer "
+                    "wrap the raw iterator itself)")
+            return iterator
+        if device_feed is False:
+            return None
+        return DeviceFeed(iterator, align=self.n_devices,
+                          sharding=batch_sharding(self.mesh, self.axis))
+
+    def _epoch_batches(self, iterator, feed):
+        """One epoch of (x, labels, n_valid) device triples."""
+        if feed is not None:
+            for fb in feed:
+                yield fb.features, fb.labels, fb.n_valid
+            return
+        iterator.reset()
+        for ds in iterator:
+            x, labels = self.pad_batch(np.asarray(ds.features),
+                                       np.asarray(ds.labels))
+            yield jnp.asarray(x), jnp.asarray(labels), None
+
+    def fit(self, iterator, epochs: int = 1,
+            device_feed: Optional[bool] = None) -> None:
         net = self.network
+        feed = self._make_feed(iterator, device_feed)
         upd_state = (net._updater_state if net._updater_state is not None
                      else self.updater.init(net._params))
         params = net._params
@@ -101,13 +142,11 @@ class DataParallelTrainer:
         try:
             with self.mesh:
                 for _ in range(epochs):
-                    iterator.reset()
-                    for ds in iterator:
-                        x, labels = self.pad_batch(np.asarray(ds.features),
-                                                   np.asarray(ds.labels))
+                    for x, labels, n_valid in self._epoch_batches(iterator,
+                                                                  feed):
                         params, upd_state, score = self._step(
-                            params, upd_state, jnp.asarray(x),
-                            jnp.asarray(labels), net.next_key())
+                            params, upd_state, x, labels, net.next_key(),
+                            n_valid)
                         steps += 1
         finally:
             # the step donates the params/state passed in — the net must

@@ -32,6 +32,7 @@ from jax.flatten_util import ravel_pytree
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.optimize.updater import ADAGRAD_EPS
+from deeplearning4j_tpu.datasets.device_feed import feed_mask
 from deeplearning4j_tpu.parallel.data_parallel import DataParallelTrainer
 from deeplearning4j_tpu.parallel.mesh import batch_sharding, replicated
 
@@ -114,9 +115,15 @@ class ShardedUpdateTrainer(DataParallelTrainer):
                     m = m * (1 - seg) + mi * seg
             return m
 
-        def step(params, hist, vel, it, x, labels, rng):
+        def step(params, hist, vel, it, x, labels, rng, n_valid=None):
+            # n_valid: device-feed real-example count (rows >= n_valid are
+            # shape-bucketing padding — masked from the loss, and the
+            # adagrad ÷batchSize uses the real count)
+            weights, count = feed_mask(x.shape[0], n_valid)
+            if weights is not None:
+                count = jnp.maximum(count, 1).astype(jnp.float32)
             score, grads = jax.value_and_grad(net.loss_fn)(
-                params, x, labels, rng=rng, training=True)
+                params, x, labels, rng=rng, training=True, weights=weights)
             flat_g, _ = ravel_pytree(grads)
             flat_g = jnp.pad(flat_g, (0, pad))
             # reduce-scatter point: the gradient becomes replica-sharded
@@ -125,24 +132,33 @@ class ShardedUpdateTrainer(DataParallelTrainer):
             scaled = jnp.where(
                 ada_vec > 0,
                 lr_vec * flat_g / (jnp.sqrt(jnp.maximum(hist, 0.0))
-                                   + ADAGRAD_EPS) / x.shape[0],
+                                   + ADAGRAD_EPS),
                 lr_vec * flat_g)
             vel = mom_at(it) * vel + scaled
+            # reference GradientAdjustment divides the FINAL update — the
+            # whole velocity — by batchSize on the adagrad branch
+            # (GradientUpdater does the same). Dividing only the fresh
+            # contribution agrees at constant batch size but diverges
+            # from NetworkGradientUpdater on ragged/masked streams where
+            # the count varies step to step.
+            update = jnp.where(ada_vec > 0, vel / count, vel)
             flat_p, _ = ravel_pytree(params)
-            flat_p = jnp.pad(flat_p, (0, pad)) - vel
+            flat_p = jnp.pad(flat_p, (0, pad)) - update
             # all-gather point: updated params become replicated again
             flat_p = jax.lax.with_sharding_constraint(flat_p[:n], rep)
             return unravel(flat_p), hist, vel, it + 1, score
 
         return jax.jit(
             step,
-            in_shardings=(rep, shard, shard, rep, bsh, bsh, rep),
+            in_shardings=(rep, shard, shard, rep, bsh, bsh, rep, rep),
             out_shardings=(rep, shard, shard, rep, rep),
             donate_argnums=(0, 1, 2),
         )
 
-    def fit(self, iterator, epochs: int = 1) -> None:
+    def fit(self, iterator, epochs: int = 1,
+            device_feed: Optional[bool] = None) -> None:
         net = self.network
+        feed = self._make_feed(iterator, device_feed)
         flat0, _ = ravel_pytree(net._params)
         n_pad = self._pad(flat0.size)
         if self._flat_state is None:
@@ -158,13 +174,11 @@ class ShardedUpdateTrainer(DataParallelTrainer):
         try:
             with self.mesh:
                 for _ in range(epochs):
-                    iterator.reset()
-                    for ds in iterator:
-                        x, labels = self.pad_batch(np.asarray(ds.features),
-                                                   np.asarray(ds.labels))
+                    for x, labels, n_valid in self._epoch_batches(iterator,
+                                                                  feed):
                         params, hist, vel, it, score = self._step(
-                            params, hist, vel, it, jnp.asarray(x),
-                            jnp.asarray(labels), net.next_key())
+                            params, hist, vel, it, x, labels,
+                            net.next_key(), n_valid)
                         steps += 1
         finally:
             net._params = params

@@ -106,7 +106,8 @@ class TensorParallelTrainer(DataParallelTrainer):
         }
         rep = NamedSharding(mesh, P())
         bsh = NamedSharding(mesh, P(self.axis))
-        return (param_sh, upd_sh, bsh, bsh, rep), (param_sh, upd_sh, rep)
+        return ((param_sh, upd_sh, bsh, bsh, rep, rep),
+                (param_sh, upd_sh, rep))
 
     def sharding_summary(self):
         """{layer: {param: spec}} for logging/tests."""

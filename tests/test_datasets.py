@@ -172,6 +172,84 @@ class TestAsyncDataSetIterator:
             while it.has_next():
                 it.next()
 
+    def test_producer_error_mid_stream_relays_through_pop(self):
+        """An error AFTER some good batches still relays through _pop:
+        the good prefix is consumable, then the producer's exception
+        surfaces on the consumer thread."""
+        from deeplearning4j_tpu.datasets import AsyncDataSetIterator
+        from deeplearning4j_tpu.datasets.api import DataSet, DataSetIterator
+
+        class ExplodesAtThree(DataSetIterator):
+            def __init__(self):
+                super().__init__(batch_size=4, num_examples=12)
+                self._i = 0
+
+            def input_columns(self):
+                return 2
+
+            def total_outcomes(self):
+                return 2
+
+            def reset(self):
+                self._i = 0
+
+            def has_next(self):
+                return True
+
+            def next(self, num=None):
+                self._i += 1
+                if self._i > 2:
+                    raise RuntimeError("disk died mid-epoch")
+                z = np.full((4, 2), self._i, np.float32)
+                return DataSet(z, z)
+
+        it = AsyncDataSetIterator(ExplodesAtThree())
+        got = []
+        with pytest.raises(RuntimeError, match="disk died"):
+            while it.has_next():
+                got.append(it.next())
+        assert [g.features[0, 0] for g in got] == [1.0, 2.0]
+
+    def test_reset_after_close_restarts(self):
+        """close() then reset() is a clean restart, not a wedged queue:
+        the full stream is available again."""
+        from deeplearning4j_tpu.datasets import AsyncDataSetIterator
+
+        it = AsyncDataSetIterator(self._source())
+        first = it.next()
+        it.close()
+        it.reset()
+        got = []
+        while it.has_next():
+            got.append(it.next())
+        assert len(got) == 4  # 64 examples / batch 16
+        np.testing.assert_allclose(got[0].features, first.features,
+                                   rtol=1e-6)
+        it.close()
+
+    def test_device_feed_wrapper_composes(self):
+        """AsyncDataSetIterator (host-assembly overlap) under DeviceFeed
+        (bucketing + H2D prefetch): content and masks survive both
+        wrappers, across two epochs (DeviceFeed resets the producer)."""
+        from deeplearning4j_tpu.datasets import (AsyncDataSetIterator,
+                                                 DeviceFeed)
+        from deeplearning4j_tpu.datasets.api import DataSet
+
+        rng = np.random.RandomState(3)
+        ds = DataSet(rng.rand(40, 4).astype(np.float32),
+                     np.eye(3, dtype=np.float32)[rng.randint(0, 3, 40)])
+        from deeplearning4j_tpu.datasets import ListDataSetIterator
+        feed = DeviceFeed(AsyncDataSetIterator(
+            ListDataSetIterator(ds, 16)))
+        for _ in range(2):  # two epochs over the same feed
+            got = list(feed)
+            assert [fb.bucket for fb in got] == [16, 16, 8]
+            assert [int(fb.n_valid) for fb in got] == [16, 16, 8]
+            rebuilt = np.concatenate(
+                [np.asarray(fb.features)[:int(fb.n_valid)] for fb in got])
+            np.testing.assert_allclose(rebuilt, ds.features, rtol=1e-6)
+        feed.close()
+
     def test_trains_through_network(self):
         """End-to-end consumer: MultiLayerNetwork.fit over the async
         iterator."""

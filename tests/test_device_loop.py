@@ -79,10 +79,23 @@ def run_loop(cls, c, loss, x0, terminations, key=None, data=()):
     opt = cls(c, loss, terminations=terminations, rng_key=key)
     assert opt._has_device_loop() and opt._device_loop_eligible()
     params, score = opt.optimize(jnp.array(x0, copy=True), *data,
-                                 rng_key=key)
-    # loop path must NOT have synced: score is a live device scalar
+                                 rng_key=key, sync=False)
+    # sync=False must NOT have synced: score is a live device scalar
     assert isinstance(score, jax.Array)
     return params, score
+
+
+def test_sync_default_returns_float_on_loop_path():
+    """optimize() defaults to sync=True: the device-loop path syncs the
+    final score to a Python float, so the return type no longer varies
+    with which path was selected (ADVICE round 5). sync=False keeps the
+    live device scalar for hot callers (exercised by run_loop above)."""
+    c = conf(iters=4, lr=0.05)
+    opt = IterationGradientDescent(
+        c, quad_loss, terminations=[EpsTermination(eps=1e-30)])
+    assert opt._has_device_loop() and opt._device_loop_eligible()
+    _, score = opt.optimize(jnp.ones((3,), jnp.float32))
+    assert isinstance(score, float)
 
 
 @pytest.mark.parametrize("cls", SOLVERS)
