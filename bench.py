@@ -361,6 +361,99 @@ def bench_glove():
             "epochs_per_window": epochs, "window_s": round(win_s, 3)}
 
 
+def bench_guardian():
+    """Guardian robustness config (docs/FAULT_TOLERANCE.md): (a) guarded
+    vs unguarded fit_scan step time — both driven as identical one-epoch
+    compiled calls so the delta isolates the fused finite-check +
+    where-commit (<2% target); (b) a NaN-injection recovery drill on the
+    guarded iterator path — the poisoned batch must never commit
+    (params finite) and the final score must land within 1e-3 of the
+    fault-free run."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.datasets import ListDataSetIterator
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.datasets.mnist import synthetic_mnist
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.guardian import GuardianPolicy
+
+    # ---- (a) guarded vs unguarded step time, chained on device
+    net_u, batch_size = _mlp_net()
+    net_g, _ = _mlp_net()
+    n_batches, epochs = (4, 2) if _fast() else (16, 16)
+    x_np, y_np = synthetic_mnist(batch_size * n_batches)
+    x, y = jnp.asarray(x_np), jnp.asarray(y_np)
+    # huge check/snapshot cadence: the window times the pure device-side
+    # guard (the ladder's host syncs are per-check, amortized separately)
+    policy = GuardianPolicy(check_every=10 ** 9, snapshot_every=10 ** 9)
+
+    def one_pass(net, guarded):
+        for _ in range(epochs):
+            if guarded:
+                net.fit_scan(x, y, batch_size=batch_size, epochs=1,
+                             guardian=policy)
+            else:
+                net.fit_scan(x, y, batch_size=batch_size, epochs=1)
+        _d2h(net.params())
+
+    one_pass(net_u, False)  # compile
+    one_pass(net_g, True)
+    steps = n_batches * epochs
+    rate_u, _ = _median_rate(lambda: one_pass(net_u, False), steps)
+    rate_g, win_s = _median_rate(lambda: one_pass(net_g, True), steps)
+    ms_u, ms_g = 1000.0 / rate_u, 1000.0 / rate_g
+    overhead_pct = (ms_g - ms_u) / ms_u * 100.0
+
+    # ---- (b) NaN-injection recovery drill (tiny net, guarded fit): ONE
+    # transient fault in a long converging stream — the guarded run skips
+    # the poisoned step and must land within 1e-3 of the clean run (the
+    # skipped batch's influence decays once both runs sit in convergence)
+    from deeplearning4j_tpu.datasets.iris import load_iris
+
+    data = load_iris()
+    ix, iy = np.asarray(data.features), np.asarray(data.labels)
+    rng = np.random.RandomState(0)
+    bs, n_steps = 24, 150
+    sel = np.concatenate([rng.choice(len(ix), bs, replace=False)
+                          for _ in range(n_steps)])
+    dx, dy = ix[sel].copy(), iy[sel].copy()
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(4).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False).momentum(0.5)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=3)
+            .pretrain(False).build())
+
+    clean = MultiLayerNetwork(conf)
+    clean.fit(ListDataSetIterator(DataSet(dx, dy), bs))
+    score_clean = clean.score(ix, iy)
+
+    dx_bad = dx.copy()
+    dx_bad[7 * bs:8 * bs] = np.nan  # one poisoned batch mid-stream
+    faulty = MultiLayerNetwork(conf)
+    faulty.fit(ListDataSetIterator(DataSet(dx_bad, dy), bs),
+               guardian=GuardianPolicy(check_every=4, snapshot_every=16))
+    params_finite = bool(np.isfinite(np.asarray(faulty.params())).all())
+    score_faulty = faulty.score(ix, iy)
+    delta = abs(score_faulty - score_clean)
+
+    return {"value": round(ms_g, 4), "unit": "ms/guarded_step",
+            "lower_is_better": True,
+            "unguarded_ms": round(ms_u, 4),
+            "overhead_pct": round(overhead_pct, 2),
+            "recovery": {"params_finite": params_finite,
+                         "score_clean": round(score_clean, 6),
+                         "score_after_nan": round(score_faulty, 6),
+                         "score_delta": round(delta, 6),
+                         "recovered": bool(params_finite and delta < 1e-3)},
+            "steps_per_window": steps, "window_s": round(win_s, 3)}
+
+
 def _flash_inputs():
     import jax
     import jax.numpy as jnp
@@ -460,6 +553,7 @@ def bench_flash_bwd():
 CONFIGS = {
     "mlp": bench_mlp,
     "feed": bench_feed,
+    "guardian": bench_guardian,
     "lenet": bench_lenet,
     "dbn": bench_dbn,
     "word2vec": bench_word2vec,
@@ -471,6 +565,7 @@ CONFIGS = {
 METRIC_NAMES = {
     "mlp": "mlp_mnist_train_samples_per_sec_per_chip",
     "feed": "device_feed_ragged_stream_steps_per_sec",
+    "guardian": "guardian_guarded_step_time_ms",
     "lenet": "lenet_mnist_step_time_ms",
     "dbn": "dbn_pretrain_finetune_samples_per_sec_per_chip",
     "word2vec": "word2vec_skipgram_pairs_per_sec",

@@ -18,29 +18,50 @@ arbitrarily long streams.
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 from typing import Optional
 
 from deeplearning4j_tpu.datasets.api import DataSet, DataSetIterator
 from deeplearning4j_tpu.runtime.native_loader import BatchQueue
+
+log = logging.getLogger(__name__)
 
 __all__ = ["AsyncDataSetIterator"]
 
 
 class AsyncDataSetIterator(DataSetIterator):
     """Wrap any DataSetIterator; batches are produced ahead of
-    consumption on a background thread through the native queue."""
+    consumption on a background thread through the native queue.
+
+    `retries`/`backoff` (opt-in, default off) make the producer survive
+    TRANSIENT source errors — a flaky network read, a storage blip: each
+    failed has_next()/next() is re-attempted up to `retries` times with
+    exponential backoff (backoff, 2*backoff, 4*backoff, ... seconds; the
+    attempt budget resets after every successful batch). A source that
+    advances its cursor before failing will skip that batch on retry —
+    only wrap sources whose next() is repeatable. When the budget is
+    exhausted the error relays to the consumer exactly as before."""
 
     def __init__(self, source: DataSetIterator, capacity: int = 4,
-                 reset_timeout: float = 10.0):
+                 reset_timeout: float = 10.0, retries: int = 0,
+                 backoff: float = 0.1):
+        if retries < 0:
+            raise ValueError(f"retries must be >= 0, got {retries}")
+        if backoff < 0:
+            raise ValueError(f"backoff must be >= 0, got {backoff}")
         self.source = source
         self.capacity = capacity
+        self.retries = retries
+        self.backoff = backoff
         self.reset_timeout = reset_timeout  # join wait for a slow source
         self._fq: Optional[BatchQueue] = None
         self._lq: Optional[BatchQueue] = None
         self._producer: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._next: Optional[DataSet] = None  # one-batch lookahead
+        self._stop = threading.Event()  # interrupts retry backoff sleeps
         super().__init__(batch_size=source.batch(),
                          num_examples=source.num_examples()
                          if self._safe_num_examples() else -1)
@@ -59,12 +80,56 @@ class AsyncDataSetIterator(DataSetIterator):
         self._lq = BatchQueue(self.capacity)
         self._error = None
         self._next = None
+        self._stop = threading.Event()
+        stop = self._stop  # this producer generation's own flag
+
+        def next_batch() -> Optional[DataSet]:
+            """One (has_next, next) cycle with the bounded retry budget;
+            None = stream exhausted. Raises once retries run out — the
+            outer handler relays, preserving historical behavior. If a
+            failed next() advanced the source PAST its end (has_next goes
+            False mid-retry), the saved error is raised rather than
+            reporting a clean-but-truncated epoch."""
+            attempt = 0
+            pending: Optional[Exception] = None
+            while True:
+                try:
+                    if not self.source.has_next():
+                        if pending is not None:
+                            raise pending
+                        return None
+                    return self.source.next()
+                except MemoryError:
+                    # retrying an allocation under memory pressure only
+                    # burns backoff sleeps — relay immediately
+                    raise
+                # KeyboardInterrupt/SystemExit are not Exception: they
+                # propagate straight to the outer relay too
+                except Exception as e:
+                    if e is pending:  # the end-of-stream re-raise above
+                        raise
+                    pending = e
+                    attempt += 1
+                    if attempt > self.retries:
+                        raise
+                    delay = self.backoff * (2 ** (attempt - 1))
+                    log.warning(
+                        "async producer: source error (attempt %d/%d), "
+                        "retrying in %.2fs: %s", attempt, self.retries,
+                        delay, e)
+                    # interruptible: reset()/close() set the stop flag so
+                    # a long backoff can't outlive the consumer (and make
+                    # reset()'s join time out on a healthy producer)
+                    if stop.wait(delay):
+                        raise
 
         def produce():
             try:
                 self.source.reset()
-                while self.source.has_next():
-                    ds = self.source.next()
+                while True:
+                    ds = next_batch()
+                    if ds is None:
+                        return
                     if not self._fq.push(ds.features):
                         return  # consumer closed
                     if not self._lq.push(ds.labels):
@@ -112,6 +177,7 @@ class AsyncDataSetIterator(DataSetIterator):
     def reset(self) -> None:
         """Tear down the in-flight producer and restart from the source's
         beginning."""
+        self._stop.set()  # wake a producer parked in a retry backoff
         self._fq.close()
         self._lq.close()
         if self._producer is not None:
@@ -127,5 +193,6 @@ class AsyncDataSetIterator(DataSetIterator):
         self._start()
 
     def close(self) -> None:
+        self._stop.set()
         self._fq.close()
         self._lq.close()

@@ -150,6 +150,10 @@ class DeviceFeed:
         self.bucket_hits = {b: 0 for b in self.buckets}
         self.padded_examples = 0
         self.batches = 0
+        #: absolute batch index within the current pass — the guardian's
+        #: checkpoint cursor reads as "batches consumed this epoch"
+        self.cursor = 0
+        self._skip_next = 0
 
     # ------------------------------------------------------------ padding
     def _pad(self, ds) -> Tuple[Any, Any, np.int32]:
@@ -188,9 +192,24 @@ class DeviceFeed:
         return FeedBatch(f, l, jax.device_put(n))
 
     # ---------------------------------------------------------- streaming
+    def fast_forward(self, n: int) -> None:
+        """Drop the first `n` source batches of the NEXT pass — the
+        mid-epoch resume primitive: position the stream at a checkpoint's
+        `iterator_position` without padding/transferring the skipped
+        batches. One-shot (the pass after consumes the whole stream
+        again); `cursor` starts at `n` for that pass."""
+        if n < 0:
+            raise ValueError(f"fast_forward must be >= 0, got {n}")
+        self._skip_next = int(n)
+
     def _host_batches(self):
         self.source.reset()
+        skip, self._skip_next = self._skip_next, 0
+        self.cursor = skip
         for ds in self.source:
+            if skip > 0:
+                skip -= 1
+                continue
             yield self._pad(ds)
 
     def __iter__(self) -> Iterator[FeedBatch]:
@@ -205,8 +224,10 @@ class DeviceFeed:
             window.append(self._put(padded))
             if len(window) < depth:
                 continue
+            self.cursor += 1
             yield window.popleft()
         while window:
+            self.cursor += 1
             yield window.popleft()
 
     # --------------------------------------------------- iterator surface

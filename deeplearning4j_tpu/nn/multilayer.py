@@ -29,6 +29,8 @@ from deeplearning4j_tpu.config.multi_layer_configuration import MultiLayerConfig
 from deeplearning4j_tpu.datasets.device_feed import DeviceFeed, feed_mask
 from deeplearning4j_tpu.nn.api import merge_params
 from deeplearning4j_tpu.nn.layers import make_layer
+from deeplearning4j_tpu.optimize.guardian import (GuardianAbort,
+                                                  guarded_update, make_guard)
 from deeplearning4j_tpu.optimize.solver import Solver
 from deeplearning4j_tpu.optimize.updater import NetworkGradientUpdater
 from deeplearning4j_tpu.utils.sanitize import validate_batch
@@ -49,9 +51,10 @@ class MultiLayerNetwork:
         self._unravel = None
         self._updater_state = None
         self._train_step = None
+        self._train_step_guarded = None
         self._finetune_solver = None
         self._batch_solver = None
-        self._scan_steps: Dict[bool, object] = {}
+        self._scan_steps: Dict[tuple, object] = {}
         self._pretrain_solvers: Dict[int, Solver] = {}
         self._pending_params = params
         self._iteration_count = 0
@@ -87,6 +90,7 @@ class MultiLayerNetwork:
         _, self._unravel = ravel_pytree(self._params)
         self._updater_state = None
         self._train_step = None
+        self._train_step_guarded = None
         self._finetune_solver = None
         self._batch_solver = None
         self._scan_steps = {}
@@ -218,8 +222,18 @@ class MultiLayerNetwork:
                 self._params[str(i)] = new_params
                 log.info("Pretrained layer %d (score=%s)", i, score)
 
+    def _resolve_feed(self, iterator, device_feed):
+        """(feed, raw_source) for an iterator-driven fit."""
+        if isinstance(iterator, DeviceFeed):
+            return iterator, iterator.source
+        if device_feed is False:
+            return None, iterator
+        return DeviceFeed(iterator), iterator
+
     def fit(self, x, labels=None, epochs: int = 1,
-            device_feed: Optional[bool] = None) -> None:
+            device_feed: Optional[bool] = None,
+            guardian=None, checkpoint_every: Optional[int] = None,
+            saver=None) -> None:
         """Train. Accepts (x, labels) arrays or a DataSetIterator
         (reference fit(DataSet) :1172 / fit(DataSetIterator) :1021).
         Pretraining (if configured) runs ONCE over the data, then the
@@ -232,27 +246,52 @@ class MultiLayerNetwork:
         batch shape, and H2D transfers prefetch ahead of the step. Pass
         `device_feed=False` for the legacy per-shape path, or pass a
         DeviceFeed instance directly as `x` for custom buckets/prefetch.
-        """
+
+        Fault tolerance (optimize/guardian.py, docs/FAULT_TOLERANCE.md):
+        `guardian=` (a GuardianPolicy, or True for defaults) switches to
+        the guarded train step — non-finite grad/loss steps are skipped
+        on device, persistent trouble rolls back to a last-good snapshot
+        with LR backoff, and `GuardianAbort` fires when the rollback
+        budget runs out (the network is left on the last-good state).
+        `checkpoint_every=N` autosaves a resumable checkpoint (params +
+        updater state + batch cursor) every N batches through `saver`
+        (default: rotating DefaultModelSaver); any configured saver also
+        arms a SIGTERM hook that flushes a final checkpoint and raises
+        `TrainingPreempted`. With everything off (the default) this is
+        the historical code path, bit for bit. Guardian requires the
+        iteration_gradient_descent backprop algorithm."""
+        guard = make_guard(self, guardian, checkpoint_every, saver)
+        if guard is None:
+            return self._fit_impl(x, labels, epochs, device_feed, None)
+        with guard:
+            return self._fit_impl(x, labels, epochs, device_feed, guard)
+
+    def _fit_impl(self, x, labels, epochs, device_feed, guard) -> None:
+        """One fit body for the guarded and historical paths — with
+        guard=None every guard hook is skipped and this is the legacy
+        code path, bit for bit."""
         if labels is None:  # iterator protocol
             iterator = x
-            if isinstance(iterator, DeviceFeed):
-                feed, raw = iterator, iterator.source
-            elif device_feed is False:
-                feed, raw = None, iterator
-            else:
-                feed, raw = DeviceFeed(iterator), iterator
+            feed, raw = self._resolve_feed(iterator, device_feed)
             if self.conf.pretrain and self.has_pretrain_layers():
-                self.pretrain(raw)
+                self.pretrain(raw)  # host-driven per-layer: unguarded
             for _ in range(epochs):
+                if guard is not None:
+                    guard.begin_epoch()
                 if feed is not None:
                     for fb in feed:
                         self._fit_supervised(fb.features, fb.labels,
-                                             n_valid=fb.n_valid)
+                                             n_valid=fb.n_valid, guard=guard)
+                        if guard is not None:
+                            guard.tick()
                 else:
                     iterator.reset()
                     for ds in iterator:
                         self._fit_supervised(jnp.asarray(ds.features),
-                                             jnp.asarray(ds.labels))
+                                             jnp.asarray(ds.labels),
+                                             guard=guard)
+                        if guard is not None:
+                            guard.tick()
             return
         x, labels = jnp.asarray(x), jnp.asarray(labels)
         validate_batch(x, labels, n_in=self.layers[0].conf.n_in
@@ -261,12 +300,21 @@ class MultiLayerNetwork:
         if self.conf.pretrain and self.has_pretrain_layers():
             self.pretrain(x)
         for _ in range(epochs):
-            self._fit_supervised(x, labels)
+            if guard is not None:
+                guard.begin_epoch()
+            self._fit_supervised(x, labels, guard=guard)
+            if guard is not None:
+                guard.tick()
 
-    def _fit_supervised(self, x, labels, n_valid=None) -> None:
+    def _fit_supervised(self, x, labels, n_valid=None, guard=None) -> None:
         if self.conf.backprop:
-            self._backprop_fit(x, labels, n_valid=n_valid)
+            self._backprop_fit(x, labels, n_valid=n_valid, guard=guard)
         else:
+            if guard is not None and guard.guarded:
+                raise ValueError(
+                    "guardian= requires the backprop iteration_gradient_"
+                    "descent path; the finetune path is host-driven "
+                    "(autosave via checkpoint_every= still works)")
             if n_valid is not None:
                 # the finetune path is host-driven and per-layer; strip
                 # the bucketing padding instead of threading a mask
@@ -277,7 +325,9 @@ class MultiLayerNetwork:
             self.finetune(x, labels)
 
     def fit_scan(self, x, labels, batch_size: int, epochs: int = 1,
-                 pad_partial: bool = False) -> float:
+                 pad_partial: bool = False, guardian=None,
+                 checkpoint_every: Optional[int] = None,
+                 saver=None) -> float:
         """Whole-epoch training as ONE compiled program: minibatches are
         a leading scan axis and `lax.scan` carries (params, updater
         state) through every step on-device — zero per-step host
@@ -301,7 +351,16 @@ class MultiLayerNetwork:
         batch_size and scans a per-batch example count alongside so the
         masked loss and the updater's ÷batchSize use the real counts —
         the device-feed masking semantics (docs/DEVICE_FEED.md), inside
-        the scan. Returns the final batch's score."""
+        the scan. Returns the final batch's score.
+
+        `guardian=` fuses the guarded commit INTO the scan body (a
+        non-finite minibatch is skipped on device, the skip counter
+        rides the scan carry) and drives epochs one compiled call each
+        so the host-side ladder/autosave/preemption hooks run between
+        epochs — one program either way. The ladder's cadences
+        (check_every etc.) stay denominated in batches (each epoch
+        advances them by n_batches); `checkpoint_every=` counts
+        epochs."""
         conf0 = self.layers[-1].conf
         if conf0.optimization_algo.lower() != "iteration_gradient_descent":
             raise ValueError("fit_scan supports iteration_gradient_descent")
@@ -334,84 +393,172 @@ class MultiLayerNetwork:
             counts[-1] = tail
             counts = jnp.asarray(counts)
 
-        if masked not in self._scan_steps:
-            updater = NetworkGradientUpdater.for_network(self)
-
-            @partial(jax.jit, donate_argnums=(0, 1),
-                     static_argnums=(4,) if not masked else (5,))
-            def epoch(params, upd_state, xb, yb, *rest):
-                if masked:
-                    bn, n_epochs, rng = rest
-                else:
-                    n_epochs, rng = rest
-                    bn = None
-
-                def body(carry, batch):
-                    params, upd_state, rng = carry
-                    if masked:
-                        bx, by, bi = batch
-                        weights, count = feed_mask(bx.shape[0], bi)
-                    else:
-                        bx, by = batch
-                        weights, count = feed_mask(bx.shape[0], None)
-                    rng, sub = jax.random.split(rng)
-                    score, grads = jax.value_and_grad(self.loss_fn)(
-                        params, bx, by, rng=sub, training=True,
-                        weights=weights)
-                    updates, upd_state = updater.update(
-                        grads, upd_state, params, count)
-                    params = jax.tree_util.tree_map(
-                        lambda p, u: p - u, params, updates)
-                    return (params, upd_state, rng), score
-
-                xs = (xb, yb, bn) if masked else (xb, yb)
-
-                def one_epoch(carry, _):
-                    carry, scores = jax.lax.scan(body, carry, xs)
-                    return carry, scores[-1]
-
-                (params, upd_state, _), last_scores = jax.lax.scan(
-                    one_epoch, (params, upd_state, rng), None,
-                    length=n_epochs)
-                return params, upd_state, last_scores[-1]
-
-            self._scan_steps[masked] = epoch
+        guard = make_guard(self, guardian, checkpoint_every, saver)
+        guarded = guard is not None and guard.guarded
+        key = (masked, guarded)
+        if key not in self._scan_steps:
+            self._scan_steps[key] = self._build_scan_step(masked, guarded)
 
         if self._updater_state is None:
             self._updater_state = NetworkGradientUpdater.for_network(
                 self).init(self._params)
-        args = ((xb, yb, counts, int(epochs)) if masked
-                else (xb, yb, int(epochs)))
-        self._params, self._updater_state, score = self._scan_steps[masked](
-            self._params, self._updater_state, *args, self.next_key())
-        self._iteration_count += epochs * n_batches
-        score = float(score)
-        for listener in self.listeners:
-            listener.iteration_done(self, self._iteration_count - 1, score)
-        return score
+        if guard is None:
+            args = ((xb, yb, counts, int(epochs)) if masked
+                    else (xb, yb, int(epochs)))
+            self._params, self._updater_state, score = self._scan_steps[key](
+                self._params, self._updater_state, *args, self.next_key())
+            self._iteration_count += epochs * n_batches
+            score = float(score)
+            for listener in self.listeners:
+                listener.iteration_done(self, self._iteration_count - 1,
+                                        score)
+            return score
 
-    def _backprop_fit(self, x, labels, n_valid=None) -> None:
+        # guarded/autosaved: one single-epoch program, driven per epoch so
+        # the host ladder and checkpoint/preemption hooks interleave
+        with guard:
+            if guarded:
+                guard.arm_once((self._params, self._updater_state))
+            args = ((xb, yb, counts, 1) if masked else (xb, yb, 1))
+            score = None
+            for _ in range(epochs):
+                guard.begin_epoch()
+                if guarded:
+                    (self._params, self._updater_state, gstate,
+                     score) = self._scan_steps[key](
+                        self._params, self._updater_state, guard.gstate,
+                        *args, self.next_key())
+                    self._iteration_count += n_batches
+                    try:
+                        # steps=n_batches: the ladder's cadences stay in
+                        # BATCHES even though observation is per-epoch
+                        live, _ = guard.post_step(
+                            (self._params, self._updater_state), gstate,
+                            score, steps=n_batches)
+                    except GuardianAbort as e:
+                        self._params, self._updater_state = e.last_good
+                        raise
+                    self._params, self._updater_state = live
+                else:
+                    (self._params, self._updater_state,
+                     score) = self._scan_steps[key](
+                        self._params, self._updater_state, *args,
+                        self.next_key())
+                    self._iteration_count += n_batches
+                guard.tick()
+            score = float(score)
+            for listener in self.listeners:
+                listener.iteration_done(self, self._iteration_count - 1,
+                                        score)
+            return score
+
+    def _build_scan_step(self, masked: bool, guarded: bool):
+        """Compile the whole-epoch program for fit_scan: `masked` scans
+        per-batch real counts alongside (device-feed masking), `guarded`
+        fuses the guardian's finite-check commit into the scan body and
+        carries (gstate, skip counter) on device."""
+        updater = NetworkGradientUpdater.for_network(self)
+        # static n_epochs position shifts with the leading gstate arg
+        static = 4 + int(masked) + int(guarded)
+
+        @partial(jax.jit, donate_argnums=(0, 1), static_argnums=(static,))
+        def epoch(params, upd_state, *rest):
+            if guarded:
+                gstate, *rest = rest
+            else:
+                gstate = None
+            if masked:
+                xb, yb, bn, n_epochs, rng = rest
+            else:
+                xb, yb, n_epochs, rng = rest
+                bn = None
+
+            def body(carry, batch):
+                params, upd_state, gstate, rng = carry
+                if masked:
+                    bx, by, bi = batch
+                    weights, count = feed_mask(bx.shape[0], bi)
+                else:
+                    bx, by = batch
+                    weights, count = feed_mask(bx.shape[0], None)
+                rng, sub = jax.random.split(rng)
+                score, grads = jax.value_and_grad(self.loss_fn)(
+                    params, bx, by, rng=sub, training=True,
+                    weights=weights)
+                updates, new_state = updater.update(
+                    grads, upd_state, params, count)
+                if guarded:
+                    params, upd_state, gstate = guarded_update(
+                        params, upd_state, updates, new_state, gstate,
+                        score, grads)
+                else:
+                    upd_state = new_state
+                    params = jax.tree_util.tree_map(
+                        lambda p, u: p - u, params, updates)
+                return (params, upd_state, gstate, rng), score
+
+            xs = (xb, yb, bn) if masked else (xb, yb)
+
+            def one_epoch(carry, _):
+                carry, scores = jax.lax.scan(body, carry, xs)
+                return carry, scores[-1]
+
+            (params, upd_state, gstate, _), last_scores = jax.lax.scan(
+                one_epoch, (params, upd_state, gstate, rng), None,
+                length=n_epochs)
+            if guarded:
+                return params, upd_state, gstate, last_scores[-1]
+            return params, upd_state, last_scores[-1]
+
+        return epoch
+
+    def _backprop_fit(self, x, labels, n_valid=None, guard=None) -> None:
         conf0 = self.layers[-1].conf
         algo = conf0.optimization_algo.lower()
+        guarded = guard is not None and guard.guarded
         if algo == "iteration_gradient_descent":
             # Hot path: one fused XLA program per step, updater state carried
             # across batches (standard minibatch SGD when num_iterations=1).
             # n_valid (device-feed path) is a TRACED count — every bucket
             # shape shares one program regardless of how full it is.
-            step = self._get_train_step()
+            step = self._get_train_step(guarded=guarded)
             if self._updater_state is None:
                 self._updater_state = NetworkGradientUpdater.for_network(
                     self).init(self._params)
+            if guarded:
+                guard.arm_once((self._params, self._updater_state))
             score = None
             for i in range(conf0.num_iterations):
-                self._params, self._updater_state, score = step(
-                    self._params, self._updater_state, x, labels,
-                    self.next_key(), n_valid)
-                self._iteration_count += 1
+                if guarded:
+                    (self._params, self._updater_state, gstate,
+                     score) = step(self._params, self._updater_state,
+                                   guard.gstate, x, labels, self.next_key(),
+                                   n_valid)
+                    self._iteration_count += 1
+                    try:
+                        live, _ = guard.post_step(
+                            (self._params, self._updater_state), gstate,
+                            score)
+                    except GuardianAbort as e:
+                        # leave the network on the last-good state the
+                        # escalation ladder kept, then surface the report
+                        self._params, self._updater_state = e.last_good
+                        raise
+                    self._params, self._updater_state = live
+                else:
+                    self._params, self._updater_state, score = step(
+                        self._params, self._updater_state, x, labels,
+                        self.next_key(), n_valid)
+                    self._iteration_count += 1
             for listener in self.listeners:
                 listener.iteration_done(self, self._iteration_count - 1,
                                         float(score))
         else:
+            if guarded:
+                raise ValueError(
+                    "guardian= supports only the iteration_gradient_descent "
+                    f"algorithm (got {algo!r}); the line-search solvers "
+                    "drive their own inner loop")
             if self._batch_solver is None:
                 _, unravel = ravel_pytree(self._params)
 
@@ -435,18 +582,27 @@ class MultiLayerNetwork:
             self._params, _ = self._batch_solver.optimize(
                 self._params, *data, rng_key=self.next_key(), sync=False)
 
-    def _get_train_step(self):
+    def _get_train_step(self, guarded: bool = False):
+        if guarded:
+            if self._train_step_guarded is None:
+                self._train_step_guarded = self._build_train_step(True)
+            return self._train_step_guarded
         if self._train_step is None:
-            updater = NetworkGradientUpdater.for_network(self)
+            self._train_step = self._build_train_step(False)
+        return self._train_step
 
-            # params/updater-state buffers are donated: the step's outputs
-            # alias their HBM instead of allocating fresh buffers each
-            # iteration (~1.4x step throughput on v5e for the MLP config).
-            # Callers must treat the passed-in trees as consumed — the fit
-            # loop rebinds self._params/_updater_state from the outputs.
-            # n_valid is None (arrays path: bit-identical legacy program)
-            # or a traced int32 count (device-feed path: rows >= n_valid
-            # are bucketing padding, masked out of loss and ÷batchSize).
+    def _build_train_step(self, guarded: bool):
+        updater = NetworkGradientUpdater.for_network(self)
+
+        # params/updater-state buffers are donated: the step's outputs
+        # alias their HBM instead of allocating fresh buffers each
+        # iteration (~1.4x step throughput on v5e for the MLP config).
+        # Callers must treat the passed-in trees as consumed — the fit
+        # loop rebinds self._params/_updater_state from the outputs.
+        # n_valid is None (arrays path: bit-identical legacy program)
+        # or a traced int32 count (device-feed path: rows >= n_valid
+        # are bucketing padding, masked out of loss and ÷batchSize).
+        if not guarded:
             @partial(jax.jit, donate_argnums=(0, 1))
             def step(params, upd_state, x, labels, rng, n_valid=None):
                 weights, count = feed_mask(x.shape[0], n_valid)
@@ -459,21 +615,43 @@ class MultiLayerNetwork:
                                                 updates)
                 return params, upd_state, score
 
-            self._train_step = step
-        return self._train_step
+            return step
+
+        # guarded variant: an all-leaves-finite predicate over grads+loss
+        # is reduced on device and the whole update commits through
+        # jnp.where — a poisoned step leaves params/updater state (and the
+        # updater's iteration counter) untouched and bumps the skip
+        # counter. gstate.lr_scale rescales committed updates so the
+        # rollback ladder can back off LR without recompiling.
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def gstep(params, upd_state, gstate, x, labels, rng, n_valid=None):
+            weights, count = feed_mask(x.shape[0], n_valid)
+            score, grads = jax.value_and_grad(self.loss_fn)(
+                params, x, labels, rng=rng, training=True, weights=weights)
+            updates, new_state = updater.update(grads, upd_state, params,
+                                                count)
+            params, upd_state, gstate = guarded_update(
+                params, upd_state, updates, new_state, gstate, score, grads)
+            return params, upd_state, gstate, score
+
+        return gstep
 
     def train_step_cache_size(self) -> int:
         """Number of XLA programs compiled for the jitted supervised train
-        step so far — the device-feed recompile counter. With shape
-        bucketing this stays at the number of buckets actually hit (the
-        traced n_valid never re-specializes); without it, one program per
-        distinct batch shape. Returns 0 before the first backprop step."""
-        if self._train_step is None:
-            return 0
-        try:
-            return int(self._train_step._cache_size())
-        except AttributeError:  # pragma: no cover — jax internals moved
-            return -1
+        step so far (unguarded + guarded variants) — the device-feed
+        recompile counter. With shape bucketing this stays at the number
+        of buckets actually hit (the traced n_valid never re-specializes);
+        without it, one program per distinct batch shape. Returns 0
+        before the first backprop step."""
+        total = 0
+        for step in (self._train_step, self._train_step_guarded):
+            if step is None:
+                continue
+            try:
+                total += int(step._cache_size())
+            except AttributeError:  # pragma: no cover — jax internals moved
+                return -1
+        return total
 
     def finetune(self, x, labels=None) -> None:
         """Optimize only the output layer on top of frozen features

@@ -5,6 +5,13 @@ from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
     ScoreIterationListener,
     ComposableIterationListener,
     CollectScoresListener,
+    CollectGuardianEvents,
+    GuardianListener,
     StepTimeListener,
     ProfilerListener,
+)
+from deeplearning4j_tpu.optimize.guardian import (  # noqa: F401
+    GuardianAbort,
+    GuardianPolicy,
+    TrainingPreempted,
 )

@@ -101,6 +101,32 @@ class StepTimeListener(IterationListener):
         }
 
 
+class GuardianListener(IterationListener):
+    """Base for listeners that want guardian events (skips, rollbacks,
+    autosaves, preemption flushes, aborts — optimize/guardian.py). Any
+    listener exposing `guardian_event` is notified; subclassing this is
+    just the convenient way to get the no-op `iteration_done`."""
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        pass
+
+    def guardian_event(self, model, event) -> None:
+        raise NotImplementedError
+
+
+class CollectGuardianEvents(GuardianListener):
+    """Test/diagnostic helper: records every GuardianEvent."""
+
+    def __init__(self):
+        self.events = []
+
+    def guardian_event(self, model, event) -> None:
+        self.events.append(event)
+
+    def kinds(self) -> list:
+        return [e.kind for e in self.events]
+
+
 class ProfilerListener(IterationListener):
     """Toggle a jax.profiler trace over iterations [start, stop).
 

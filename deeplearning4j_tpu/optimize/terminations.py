@@ -39,3 +39,28 @@ class Norm2Termination(TerminationCondition):
 
     def terminate(self, new_score, old_score, grad_norm) -> bool:
         return grad_norm < self.gradient_tolerance
+
+
+class DivergenceCondition(TerminationCondition):
+    """EpsTermination's inverse: fires when the score has blown UP —
+    the training guardian's rollback trigger (optimize/guardian.py).
+
+    `terminate(new_score, best_score, grad_norm)` is True when
+    `new_score` is non-finite, or exceeds `best_score` (the best recent
+    score the caller tracks) by more than `factor` times its magnitude
+    (same |score|+tolerance normalization as EpsTermination, so a score
+    hovering near zero doesn't trip on noise)."""
+
+    def __init__(self, factor: float = 3.0, tolerance: float = 1e-8):
+        if factor <= 0:
+            raise ValueError(f"factor must be > 0, got {factor}")
+        self.factor = factor
+        self.tolerance = tolerance
+
+    def terminate(self, new_score, old_score, grad_norm) -> bool:
+        if not math.isfinite(new_score):
+            return True
+        if not math.isfinite(old_score):
+            return False
+        return (new_score - old_score) > self.factor * (abs(old_score)
+                                                        + self.tolerance)

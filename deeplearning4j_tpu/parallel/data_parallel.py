@@ -18,6 +18,7 @@ SURVEY §2.8) with two TPU-native modes:
 
 from __future__ import annotations
 
+import contextlib
 from typing import Optional
 
 import jax
@@ -25,6 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.datasets.device_feed import DeviceFeed, feed_mask
+from deeplearning4j_tpu.optimize.guardian import (GuardianAbort,
+                                                  guarded_update, make_guard)
 from deeplearning4j_tpu.optimize.updater import NetworkGradientUpdater
 from deeplearning4j_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -45,26 +48,47 @@ class DataParallelTrainer:
         self.n_devices = int(np.prod(self.mesh.devices.shape))
         self.updater = NetworkGradientUpdater.for_network(network)
         self._step = self._build_step()
+        self._gstep = None  # guarded variant, built on first guarded fit
 
-    def _step_fn(self):
+    def _step_fn(self, guarded: bool = False):
         """The shared train-step body; subclasses vary only shardings.
         `n_valid` is None (legacy pad_batch path — bit-identical program)
         or a traced int32 real-example count from the device feed: rows
         >= n_valid are bucketing padding, masked out of the loss and the
-        updater's ÷batchSize."""
+        updater's ÷batchSize.
+
+        `guarded` adds the guardian commit (optimize/guardian.py): the
+        all-leaves-finite predicate is reduced over the GLOBAL (already
+        all-reduced under GSPMD) gradients, so every replica computes the
+        same scalar and the whole mesh commits or skips the update
+        together — no replica can diverge from the others."""
         net = self.network
         updater = self.updater
 
-        def step(params, upd_state, x, labels, rng, n_valid=None):
+        def body(params, upd_state, x, labels, rng, n_valid, gstate):
             weights, count = feed_mask(x.shape[0], n_valid)
             score, grads = jax.value_and_grad(net.loss_fn)(
                 params, x, labels, rng=rng, training=True, weights=weights)
-            updates, upd_state = updater.update(grads, upd_state, params,
+            updates, new_state = updater.update(grads, upd_state, params,
                                                 count)
-            params = jax.tree_util.tree_map(lambda p, u: p - u, params, updates)
-            return params, upd_state, score
+            if gstate is None:
+                params = jax.tree_util.tree_map(lambda p, u: p - u, params,
+                                                updates)
+                return params, new_state, score
+            params, upd_state, gstate = guarded_update(
+                params, upd_state, updates, new_state, gstate, score, grads)
+            return params, upd_state, gstate, score
 
-        return step
+        if not guarded:
+            def step(params, upd_state, x, labels, rng, n_valid=None):
+                return body(params, upd_state, x, labels, rng, n_valid, None)
+
+            return step
+
+        def gstep(params, upd_state, gstate, x, labels, rng, n_valid=None):
+            return body(params, upd_state, x, labels, rng, n_valid, gstate)
+
+        return gstep
 
     def _step_shardings(self):
         """(in_shardings, out_shardings) for (params, upd_state, x,
@@ -81,6 +105,18 @@ class DataParallelTrainer:
             self._step_fn(),
             in_shardings=ins,
             out_shardings=outs,
+            donate_argnums=(0, 1),
+        )
+
+    def _build_guarded_step(self):
+        """The guarded step under the subclass's own shardings: the
+        GuardianState carry slots in replicated after (params, state)."""
+        ins, outs = self._step_shardings()
+        rep = replicated(self.mesh)
+        return jax.jit(
+            self._step_fn(guarded=True),
+            in_shardings=(ins[0], ins[1], rep, *ins[2:]),
+            out_shardings=(outs[0], outs[1], rep, outs[2]),
             donate_argnums=(0, 1),
         )
 
@@ -102,20 +138,33 @@ class DataParallelTrainer:
         data-axis size (equal shards), features/labels device_put with the
         batch sharding so the H2D transfer lands pre-sharded and
         prefetches ahead of the step. None = legacy pad_batch path."""
+        # the batch only shards over the DATA axis — divisibility by the
+        # full device count would over-pad (and over-reject) on tp x dp
+        # meshes where model shards don't split the batch
+        data_shards = int(self.mesh.shape[self.axis])
         if isinstance(iterator, DeviceFeed):
-            bad = [b for b in iterator.buckets if b % self.n_devices]
+            bad = [b for b in iterator.buckets if b % data_shards]
             if bad:
                 # fail here with the real constraint, not later with an
                 # opaque GSPMD divisibility error at step dispatch
                 raise ValueError(
                     f"DeviceFeed buckets {bad} are not multiples of the "
-                    f"data-axis size {self.n_devices}; build the feed "
-                    f"with align={self.n_devices} (or let the trainer "
+                    f"data-axis size {data_shards}; build the feed "
+                    f"with align={data_shards} (or let the trainer "
                     "wrap the raw iterator itself)")
             return iterator
         if device_feed is False:
             return None
-        return DeviceFeed(iterator, align=self.n_devices,
+        if device_feed is None and jax.process_count() > 1 \
+                and jax.devices()[0].platform == "cpu":
+            # Gloo/CPU test clusters cannot device_put host data against a
+            # cross-process sharding ("Multiprocess computations aren't
+            # implemented on the CPU backend" from the consistency check
+            # inside device_put); the legacy path feeds host numpy straight
+            # into the jitted step, which shards it correctly. Explicit
+            # device_feed=True keeps the override for backends that can.
+            return None
+        return DeviceFeed(iterator, align=data_shards,
                           sharding=batch_sharding(self.mesh, self.axis))
 
     def _epoch_batches(self, iterator, feed):
@@ -131,23 +180,56 @@ class DataParallelTrainer:
             yield jnp.asarray(x), jnp.asarray(labels), None
 
     def fit(self, iterator, epochs: int = 1,
-            device_feed: Optional[bool] = None) -> None:
+            device_feed: Optional[bool] = None, guardian=None,
+            checkpoint_every: Optional[int] = None, saver=None) -> None:
+        """Data-parallel fit. `guardian=`/`checkpoint_every=`/`saver=`
+        arm the training guardian exactly as in MultiLayerNetwork.fit —
+        the guarded commit decision is computed from the globally
+        all-reduced gradients, so all replicas commit or skip each step
+        together (docs/FAULT_TOLERANCE.md)."""
         net = self.network
+        guard = make_guard(net, guardian, checkpoint_every, saver)
+        guarded = guard is not None and guard.guarded
+        if guarded and self._gstep is None:
+            self._gstep = self._build_guarded_step()
         feed = self._make_feed(iterator, device_feed)
         upd_state = (net._updater_state if net._updater_state is not None
                      else self.updater.init(net._params))
         params = net._params
         score = None
         steps = 0
+        ctx = guard if guard is not None else contextlib.nullcontext()
         try:
-            with self.mesh:
+            with ctx, self.mesh:
+                if guarded:
+                    guard.arm_once((params, upd_state))
                 for _ in range(epochs):
+                    if guard is not None:
+                        guard.begin_epoch()
                     for x, labels, n_valid in self._epoch_batches(iterator,
                                                                   feed):
-                        params, upd_state, score = self._step(
-                            params, upd_state, x, labels, net.next_key(),
-                            n_valid)
+                        if guarded:
+                            params, upd_state, gstate, score = self._gstep(
+                                params, upd_state, guard.gstate, x, labels,
+                                net.next_key(), n_valid)
+                            try:
+                                ((params, upd_state),
+                                 _) = guard.post_step((params, upd_state),
+                                                      gstate, score)
+                            except GuardianAbort as e:
+                                params, upd_state = e.last_good
+                                raise
+                        else:
+                            params, upd_state, score = self._step(
+                                params, upd_state, x, labels, net.next_key(),
+                                n_valid)
                         steps += 1
+                        if guard is not None:
+                            # keep the net's view current so autosave /
+                            # preemption flush checkpoint the live state
+                            net._params = params
+                            net._updater_state = upd_state
+                            guard.tick()
         finally:
             # the step donates the params/state passed in — the net must
             # always point at the live outputs, even on an interrupted fit

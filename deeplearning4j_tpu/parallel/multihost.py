@@ -21,6 +21,7 @@ processes join one runtime and train data-parallel to identical params.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Dict, Optional
 
 import jax
@@ -33,11 +34,81 @@ __all__ = ["initialize", "global_data_mesh", "process_info",
            "local_batch_slice"]
 
 
+def _wait_for_coordinator(coordinator_address: str, process_id: int,
+                          num_processes: int, timeout: float,
+                          retries: int, backoff: float) -> None:
+    """Probe the coordinator's TCP port before handing control to
+    jax.distributed.initialize. This is what makes a dead coordinator a
+    catchable Python error: jax's own deadline path ends in an abseil
+    CHECK-failure that KILLS the process (client.h "Terminating process
+    ... DEADLINE_EXCEEDED"), which no retry wrapper can recover. Each
+    attempt polls the port for up to `timeout` seconds (covers a
+    coordinator that is still starting); attempts back off
+    exponentially."""
+    import socket
+
+    host, _, port_s = coordinator_address.rpartition(":")
+    # gRPC-style bracketed IPv6 ("[::1]:1234"): sockets want the bare host
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise ValueError(
+            f"coordinator_address must be host:port, got "
+            f"{coordinator_address!r}") from None
+    attempt = 0
+    last_err: Optional[Exception] = None
+    while True:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                with socket.create_connection(
+                        (host or "127.0.0.1", port),
+                        timeout=max(0.1, min(2.0, timeout))):
+                    return
+            except OSError as e:  # refused (instant) or unreachable
+                last_err = e
+                time.sleep(min(0.5, timeout / 4))
+        attempt += 1
+        if attempt > retries:
+            raise RuntimeError(
+                f"could not join the JAX distributed runtime as process "
+                f"{process_id}/{num_processes}: coordinator "
+                f"{coordinator_address} did not respond within "
+                f"{timeout:g}s ({attempt} attempt(s)); is the coordinator "
+                f"process up and the address reachable? "
+                f"[{last_err}]") from last_err
+        delay = backoff * (2 ** (attempt - 1))
+        log.warning(
+            "coordinator %s unreachable (attempt %d/%d: %s); retrying "
+            "in %.1fs", coordinator_address, attempt, retries + 1,
+            last_err, delay)
+        time.sleep(delay)
+
+
 def initialize(coordinator_address: str, num_processes: int,
-               process_id: int, **kw) -> None:
+               process_id: int, *, timeout: float = 300.0,
+               retries: int = 0, backoff: float = 2.0, **kw) -> None:
     """Join this process to the JAX distributed runtime (reference
     equivalent: worker joining the Akka cluster via seed node /
-    ZooKeeper-registered address). Call once, before any backend use."""
+    ZooKeeper-registered address). Call once, before any backend use.
+
+    A dead or unreachable coordinator fails with a catchable, BOUNDED
+    error naming the address, instead of jax's hang that ends in a
+    process-killing CHECK failure: non-coordinator processes first probe
+    the coordinator port (up to `retries`+1 attempts of `timeout`
+    seconds each, exponential `backoff` between them — tolerating a
+    coordinator that boots late), and only then enter
+    jax.distributed.initialize, whose own barrier stays bounded by
+    initialization_timeout (defaulted to `timeout`). The default
+    `timeout` matches jax's 300 s so slow cluster bring-up keeps
+    working; drop it (e.g. timeout=30, retries=2) where fail-fast
+    matters more."""
+    if process_id != 0:  # process 0 IS the coordinator: it binds the port
+        _wait_for_coordinator(coordinator_address, process_id,
+                              num_processes, timeout, retries, backoff)
+    kw.setdefault("initialization_timeout", max(1, int(timeout)))
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id, **kw)

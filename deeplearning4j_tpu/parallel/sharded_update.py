@@ -23,6 +23,7 @@ global norm and is rejected.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Optional
 
 import jax
@@ -31,6 +32,8 @@ import numpy as np
 from jax.flatten_util import ravel_pytree
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.optimize.guardian import (GuardianAbort, advance,
+                                                  all_finite, make_guard)
 from deeplearning4j_tpu.optimize.updater import ADAGRAD_EPS
 from deeplearning4j_tpu.datasets.device_feed import feed_mask
 from deeplearning4j_tpu.parallel.data_parallel import DataParallelTrainer
@@ -86,7 +89,7 @@ class ShardedUpdateTrainer(DataParallelTrainer):
     def _pad(self, n: int) -> int:
         return (n + self.n_devices - 1) // self.n_devices * self.n_devices
 
-    def _build_step(self):
+    def _build_step(self, guarded: bool = False):
         net = self.network
         rep = replicated(self.mesh)
         bsh = batch_sharding(self.mesh, self.axis)
@@ -115,7 +118,7 @@ class ShardedUpdateTrainer(DataParallelTrainer):
                     m = m * (1 - seg) + mi * seg
             return m
 
-        def step(params, hist, vel, it, x, labels, rng, n_valid=None):
+        def body(params, hist, vel, it, x, labels, rng, n_valid, gstate):
             # n_valid: device-feed real-example count (rows >= n_valid are
             # shape-bucketing padding — masked from the loss, and the
             # adagrad ÷batchSize uses the real count)
@@ -128,36 +131,114 @@ class ShardedUpdateTrainer(DataParallelTrainer):
             flat_g = jnp.pad(flat_g, (0, pad))
             # reduce-scatter point: the gradient becomes replica-sharded
             flat_g = jax.lax.with_sharding_constraint(flat_g, shard)
-            hist = hist + ada_vec * jnp.square(flat_g)
+            new_hist = hist + ada_vec * jnp.square(flat_g)
             scaled = jnp.where(
                 ada_vec > 0,
-                lr_vec * flat_g / (jnp.sqrt(jnp.maximum(hist, 0.0))
+                lr_vec * flat_g / (jnp.sqrt(jnp.maximum(new_hist, 0.0))
                                    + ADAGRAD_EPS),
                 lr_vec * flat_g)
-            vel = mom_at(it) * vel + scaled
+            new_vel = mom_at(it) * vel + scaled
             # reference GradientAdjustment divides the FINAL update — the
             # whole velocity — by batchSize on the adagrad branch
             # (GradientUpdater does the same). Dividing only the fresh
             # contribution agrees at constant batch size but diverges
             # from NetworkGradientUpdater on ragged/masked streams where
             # the count varies step to step.
-            update = jnp.where(ada_vec > 0, vel / count, vel)
+            update = jnp.where(ada_vec > 0, new_vel / count, new_vel)
             flat_p, _ = ravel_pytree(params)
-            flat_p = jnp.pad(flat_p, (0, pad)) - update
-            # all-gather point: updated params become replicated again
-            flat_p = jax.lax.with_sharding_constraint(flat_p[:n], rep)
-            return unravel(flat_p), hist, vel, it + 1, score
+            flat_p = jnp.pad(flat_p, (0, pad))
+            if gstate is None:
+                new_flat_p = flat_p - update
+                # all-gather point: updated params replicate again
+                out_p = jax.lax.with_sharding_constraint(new_flat_p[:n], rep)
+                return unravel(out_p), new_hist, new_vel, it + 1, score
+            # guarded: the finite predicate reduces over the SHARDED flat
+            # gradient — GSPMD all-reduces the scalar, so every replica
+            # sees the same commit/skip decision (the cross-replica
+            # agreement of arXiv:2004.13336, for the fault path)
+            ok = all_finite(score, flat_g)
+            new_flat_p = flat_p - update * gstate.lr_scale
+            out_p = jnp.where(ok, new_flat_p, flat_p)
+            out_p = jax.lax.with_sharding_constraint(out_p[:n], rep)
+            hist = jnp.where(ok, new_hist, hist)
+            vel = jnp.where(ok, new_vel, vel)
+            it = jnp.where(ok, it + 1, it)
+            return unravel(out_p), hist, vel, it, advance(gstate, ok), score
+
+        if not guarded:
+            def step(params, hist, vel, it, x, labels, rng, n_valid=None):
+                return body(params, hist, vel, it, x, labels, rng, n_valid,
+                            None)
+
+            return jax.jit(
+                step,
+                in_shardings=(rep, shard, shard, rep, bsh, bsh, rep, rep),
+                out_shardings=(rep, shard, shard, rep, rep),
+                donate_argnums=(0, 1, 2),
+            )
+
+        def gstep(params, hist, vel, it, gstate, x, labels, rng,
+                  n_valid=None):
+            return body(params, hist, vel, it, x, labels, rng, n_valid,
+                        gstate)
 
         return jax.jit(
-            step,
-            in_shardings=(rep, shard, shard, rep, bsh, bsh, rep, rep),
-            out_shardings=(rep, shard, shard, rep, rep),
+            gstep,
+            in_shardings=(rep, shard, shard, rep, rep, bsh, bsh, rep, rep),
+            out_shardings=(rep, shard, shard, rep, rep, rep),
             donate_argnums=(0, 1, 2),
         )
 
+    def _build_guarded_step(self):
+        return self._build_step(guarded=True)
+
     def fit(self, iterator, epochs: int = 1,
-            device_feed: Optional[bool] = None) -> None:
+            device_feed: Optional[bool] = None, guardian=None,
+            checkpoint_every: Optional[int] = None, saver=None) -> None:
+        """ZeRO-1 fit; guardian/autosave semantics as DataParallelTrainer.
+        Autosaved checkpoints carry the replica-sharded flat optimizer
+        state (host-gathered) under metadata['zero1_flat_state'] — restore
+        it with `restore_flat_state(info['metadata'])` after rebuilding
+        the trainer."""
         net = self.network
+
+        def gather(a):
+            # multi-host mesh: each process holds only its local shards,
+            # and np.asarray on a non-addressable jax.Array raises —
+            # allgather the replica-sharded flat vectors first (this is
+            # the pod-preemption flush path; correctness over bandwidth)
+            if getattr(a, "is_fully_addressable", True):
+                return np.asarray(a)
+            from jax.experimental import multihost_utils
+            return np.asarray(multihost_utils.process_allgather(a,
+                                                                tiled=True))
+
+        def save_flat(saver_, position, meta):
+            hist_, vel_, it_ = self._flat_state
+            meta = dict(meta)
+            if (meta.get("save_kind") == "preempt"
+                    and not getattr(hist_, "is_fully_addressable", True)):
+                # preemption flush: SIGTERM lands on hosts at different
+                # batches, so entering the allgather here would mismatch
+                # collective order across processes (hang/crash). Save
+                # params-only; periodic autosaves (same position on every
+                # process) carry the full flat state.
+                meta["zero1_flat_state_skipped"] = (
+                    "multi-host preemption flush skips the optimizer-state "
+                    "allgather; resume from the last periodic autosave's "
+                    "zero1_flat_state")
+            else:
+                meta["zero1_flat_state"] = {
+                    "hist": gather(hist_), "velocity": gather(vel_),
+                    "iteration": np.asarray(it_)}
+            return saver_.save(net, iterator_position=position,
+                               metadata=meta)
+
+        guard = make_guard(net, guardian, checkpoint_every, saver,
+                           save_fn=save_flat)
+        guarded = guard is not None and guard.guarded
+        if guarded and self._gstep is None:
+            self._gstep = self._build_guarded_step()
         feed = self._make_feed(iterator, device_feed)
         flat0, _ = ravel_pytree(net._params)
         n_pad = self._pad(flat0.size)
@@ -171,18 +252,51 @@ class ShardedUpdateTrainer(DataParallelTrainer):
         params = net._params
         score = None
         steps = 0
+        ctx = guard if guard is not None else contextlib.nullcontext()
         try:
-            with self.mesh:
+            with ctx, self.mesh:
+                if guarded:
+                    guard.arm_once((params, hist, vel, it))
                 for _ in range(epochs):
+                    if guard is not None:
+                        guard.begin_epoch()
                     for x, labels, n_valid in self._epoch_batches(iterator,
                                                                   feed):
-                        params, hist, vel, it, score = self._step(
-                            params, hist, vel, it, x, labels,
-                            net.next_key(), n_valid)
+                        if guarded:
+                            (params, hist, vel, it, gstate,
+                             score) = self._gstep(params, hist, vel, it,
+                                                  guard.gstate, x, labels,
+                                                  net.next_key(), n_valid)
+                            try:
+                                ((params, hist, vel, it),
+                                 _) = guard.post_step((params, hist, vel, it),
+                                                      gstate, score)
+                            except GuardianAbort as e:
+                                params, hist, vel, it = e.last_good
+                                raise
+                        else:
+                            params, hist, vel, it, score = self._step(
+                                params, hist, vel, it, x, labels,
+                                net.next_key(), n_valid)
                         steps += 1
+                        if guard is not None:
+                            net._params = params
+                            self._flat_state = (hist, vel, it)
+                            guard.tick()
         finally:
             net._params = params
             self._flat_state = (hist, vel, it)
         if steps:
             for listener in net.listeners:
                 listener.iteration_done(net, steps - 1, float(score))
+
+    def restore_flat_state(self, metadata: dict) -> None:
+        """Reinstall the flat optimizer state an autosaved checkpoint
+        carried (metadata['zero1_flat_state']), re-sharding it over the
+        mesh's data axis."""
+        state = metadata["zero1_flat_state"]
+        shard = NamedSharding(self.mesh, P(self.axis))
+        self._flat_state = (
+            jax.device_put(jnp.asarray(state["hist"]), shard),
+            jax.device_put(jnp.asarray(state["velocity"]), shard),
+            jnp.asarray(state["iteration"], jnp.int32))

@@ -21,6 +21,12 @@ recipe — pick a mesh, annotate shardings, let XLA insert collectives:
 
 Non-2-D layers (conv stacks etc.) and the small output layer stay
 replicated; uneven splits raise rather than silently padding.
+
+Fault tolerance: `fit(guardian=..., checkpoint_every=...)` inherits the
+DataParallelTrainer guardian wiring — the guarded commit's finite
+predicate reduces over the model-sharded gradients (GSPMD all-reduces
+the scalar across BOTH mesh axes), so a NaN on any tp or dp shard skips
+the update everywhere; the GuardianState carry rides replicated.
 """
 
 from __future__ import annotations

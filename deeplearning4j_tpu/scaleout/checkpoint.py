@@ -34,6 +34,15 @@ from deeplearning4j_tpu.optimize.updater import UpdaterState
 _NAMEDTUPLES = {"UpdaterState": UpdaterState}
 
 
+def register_namedtuple(cls) -> None:
+    """Allow `cls` (a NamedTuple type) in checkpoint payload pytrees —
+    round-trips by field name through the manifest. Modules defining
+    checkpointable carries (e.g. optimize.guardian.GuardianState) call
+    this at import time rather than this module importing them (which
+    would invert the dependency)."""
+    _NAMEDTUPLES[cls.__name__] = cls
+
+
 def _encode_tree(obj, arrays: Dict[str, np.ndarray]):
     """Encode a pytree of arrays/scalars/containers into a JSON-able
     manifest, moving every array leaf into `arrays` under a fresh key."""

@@ -40,6 +40,10 @@ def pytest_configure(config):
         "markers",
         "tpu: opt-in real-chip lane — runs only under "
         "DL4J_TPU_TEST_PLATFORM=axon pytest -m tpu (README 'Testing')")
+    config.addinivalue_line(
+        "markers",
+        "slow: long soak/drill tests excluded from tier-1 (which runs "
+        "-m 'not slow'); run explicitly with pytest -m slow")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -210,6 +210,76 @@ class TestAsyncDataSetIterator:
                 got.append(it.next())
         assert [g.features[0, 0] for g in got] == [1.0, 2.0]
 
+    def _flaky_source(self, fail_times):
+        """Source whose next() raises `fail_times` times per batch index
+        before succeeding — a transient storage blip."""
+        from deeplearning4j_tpu.datasets.api import DataSet, DataSetIterator
+
+        class Flaky(DataSetIterator):
+            def __init__(self):
+                super().__init__(batch_size=4, num_examples=12)
+                self._i = 0
+                self._fails = {}
+                self.attempts = 0
+
+            def input_columns(self):
+                return 2
+
+            def total_outcomes(self):
+                return 2
+
+            def reset(self):
+                self._i = 0
+
+            def has_next(self):
+                return self._i < 3
+
+            def next(self, num=None):
+                self.attempts += 1
+                seen = self._fails.get(self._i, 0)
+                if seen < fail_times:
+                    self._fails[self._i] = seen + 1
+                    raise IOError(f"transient blip on batch {self._i}")
+                self._i += 1
+                z = np.full((4, 2), self._i, np.float32)
+                return DataSet(z, z)
+
+        return Flaky()
+
+    def test_retry_recovers_from_transient_errors(self):
+        """Opt-in bounded retry: every batch fails twice before
+        succeeding; retries=3 delivers the full stream with no error
+        surfacing to the consumer."""
+        from deeplearning4j_tpu.datasets import AsyncDataSetIterator
+
+        src = self._flaky_source(fail_times=2)
+        it = AsyncDataSetIterator(src, retries=3, backoff=0.001)
+        got = []
+        while it.has_next():
+            got.append(it.next())
+        assert [g.features[0, 0] for g in got] == [1.0, 2.0, 3.0]
+        assert src.attempts == 9  # 3 batches x (2 failures + 1 success)
+
+    def test_retry_budget_exhausted_relays_error(self):
+        """When failures outlast the budget, the historical error-relay
+        behavior is preserved: the source's exception reaches the
+        consumer thread."""
+        from deeplearning4j_tpu.datasets import AsyncDataSetIterator
+
+        it = AsyncDataSetIterator(self._flaky_source(fail_times=5),
+                                  retries=2, backoff=0.001)
+        with pytest.raises(IOError, match="transient blip"):
+            while it.has_next():
+                it.next()
+
+    def test_retry_off_by_default(self):
+        from deeplearning4j_tpu.datasets import AsyncDataSetIterator
+
+        it = AsyncDataSetIterator(self._flaky_source(fail_times=1))
+        with pytest.raises(IOError, match="transient blip"):
+            while it.has_next():
+                it.next()
+
     def test_reset_after_close_restarts(self):
         """close() then reset() is a clean restart, not a wedged queue:
         the full stream is available again."""
@@ -269,3 +339,37 @@ class TestAsyncDataSetIterator:
         it = AsyncDataSetIterator(self._source(n=128, batch=32))
         net.fit(it, epochs=2)  # reset() between epochs restarts producer
         assert net._iteration_count > 0
+
+
+def test_reset_interrupts_retry_backoff():
+    """reset() during a long retry backoff must not time out waiting for
+    a producer parked in time.sleep (regression: uninterruptible
+    backoff made a healthy reset raise)."""
+    import time as _time
+
+    from deeplearning4j_tpu.datasets import AsyncDataSetIterator
+    from deeplearning4j_tpu.datasets.api import DataSetIterator
+
+    class AlwaysFails(DataSetIterator):
+        def __init__(self):
+            super().__init__(batch_size=4, num_examples=8)
+
+        def input_columns(self):
+            return 2
+
+        def total_outcomes(self):
+            return 2
+
+        def has_next(self):
+            return True
+
+        def next(self, num=None):
+            raise IOError("flaky")
+
+    it = AsyncDataSetIterator(AlwaysFails(), retries=10, backoff=30.0,
+                              reset_timeout=5.0)
+    _time.sleep(0.2)  # let the producer enter its first 30s backoff
+    t0 = _time.perf_counter()
+    it.reset()  # must interrupt the sleep, not wait 30s
+    assert _time.perf_counter() - t0 < 5.0
+    it.close()

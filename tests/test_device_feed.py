@@ -89,6 +89,29 @@ class TestDeviceFeedStream:
         assert len(list(feed)) == 4
         assert len(list(feed)) == 4  # second epoch restarts from 0
 
+    def test_cursor_counts_consumed_batches(self):
+        feed = DeviceFeed(ListDataSetIterator(_data(64), 16))
+        it = iter(feed)
+        next(it)
+        assert feed.cursor == 1
+        list(it)
+        assert feed.cursor == 4
+
+    def test_fast_forward_skips_batches_once(self):
+        """Mid-epoch resume primitive (guardian checkpoints): the next
+        pass starts at the cursor, skipped batches never reach the
+        device; the pass after is whole again."""
+        ds = _data(64)
+        feed = DeviceFeed(ListDataSetIterator(ds, 16))
+        feed.fast_forward(2)
+        got = list(feed)
+        assert len(got) == 2 and feed.cursor == 4
+        np.testing.assert_allclose(np.asarray(got[0].features),
+                                   ds.features[32:48], rtol=1e-6)
+        assert len(list(feed)) == 4  # one-shot: next pass is complete
+        with pytest.raises(ValueError):
+            feed.fast_forward(-1)
+
     def test_stats_count_buckets_and_padding(self):
         feed = DeviceFeed(ListDataSetIterator(_data(100), 32))
         list(feed)

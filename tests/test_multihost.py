@@ -21,6 +21,10 @@ proc_id, nprocs, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
 local_devices = int(os.environ.get("TEST_LOCAL_DEVICES", "1"))
 import jax
 jax.config.update("jax_platforms", "cpu")
+# cross-process CPU computations need an explicit collectives backend —
+# without this the step fails with "Multiprocess computations aren't
+# implemented on the CPU backend" (default implementation is 'none')
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 from deeplearning4j_tpu.parallel import multihost
 
@@ -152,3 +156,43 @@ def test_two_process_multidevice_mesh_matches_single_process(tmp_path):
     trainer.fit(ListDataSetIterator(DataSet(x, y), batch_size=48), epochs=3)
     ref = np.asarray(net.params())
     np.testing.assert_allclose(a, ref, rtol=1e-4, atol=1e-6)
+
+
+def test_initialize_fails_fast_against_dead_coordinator():
+    """ISSUE 2 satellite: a dead/unreachable coordinator must produce a
+    bounded, CATCHABLE failure naming the address and attempt count —
+    jax's own deadline path check-fails and kills the process, so the
+    probe must raise before jax.distributed is ever entered (which also
+    keeps this test in-process safe: no distributed global state is
+    touched)."""
+    import time
+
+    import pytest
+
+    from deeplearning4j_tpu.parallel import multihost
+
+    t0 = time.time()
+    with pytest.raises(RuntimeError) as exc:
+        # nothing listens on port 9; two bounded attempts then raise
+        multihost.initialize("127.0.0.1:9", 2, 1, timeout=0.5, retries=1,
+                             backoff=0.2)
+    msg = str(exc.value)
+    assert "127.0.0.1:9" in msg, f"error must name the coordinator: {msg}"
+    assert "2 attempt" in msg, f"error must count attempts: {msg}"
+    assert time.time() - t0 < 30, "did not fail fast"
+
+
+def test_initialize_probe_finds_live_port():
+    """The probe half of initialize: a listening socket satisfies the
+    coordinator wait immediately (the jax join itself is exercised by
+    the two-process tests above)."""
+    from deeplearning4j_tpu.parallel.multihost import _wait_for_coordinator
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    s.listen(1)
+    try:
+        _wait_for_coordinator(f"127.0.0.1:{s.getsockname()[1]}", 1, 2,
+                              timeout=2.0, retries=0, backoff=0.1)
+    finally:
+        s.close()

@@ -61,7 +61,11 @@ def test_mlp_learns_iris():
     net = MultiLayerNetwork(mlp_conf(lr=0.1, iters=1))
     initial = net.score(data.features, data.labels)
     it = ListDataSetIterator(data, batch_size=30)
-    net.fit(it, epochs=60)
+    # 150 epochs: the run is deterministic (fixed conf seed) and lands at
+    # ~0.38x the initial score — solid margin under the 0.5x bar, where 60
+    # epochs sat at 0.52x (a hair over). Epochs are nearly free here: one
+    # compiled step, 5 dispatches per epoch on 150 examples.
+    net.fit(it, epochs=150)
     final = net.score(data.features, data.labels)
     assert final < initial * 0.5, (initial, final)
 

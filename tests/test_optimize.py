@@ -120,3 +120,21 @@ def test_profiler_listener_writes_trace(tmp_path):
     found = [p for p, _, files in __import__("os").walk(tmp_path)
              if any(f.endswith((".xplane.pb", ".trace.json.gz")) for f in files)]
     assert found, "no profiler trace written"
+
+
+def test_divergence_condition_semantics():
+    """Guardian rollback trigger (optimize/terminations.py): fires on
+    score blow-up or non-finite score, never on improvement, and is
+    noise-tolerant near zero (EpsTermination-style normalization)."""
+    from deeplearning4j_tpu.optimize.terminations import DivergenceCondition
+
+    d = DivergenceCondition(factor=3.0)
+    assert d.terminate(float("nan"), 1.0, 0.0)
+    assert d.terminate(float("inf"), 1.0, 0.0)
+    assert d.terminate(10.0, 1.0, 0.0)  # 9 > 3*1
+    assert not d.terminate(3.9, 1.0, 0.0)  # 2.9 < 3*1
+    assert not d.terminate(0.5, 1.0, 0.0)  # improvement never fires
+    assert not d.terminate(1e-9, 1e-10, 0.0)  # near-zero noise tolerated
+    assert not d.terminate(1.0, float("nan"), 0.0)  # unknown best: pass
+    with pytest.raises(ValueError):
+        DivergenceCondition(factor=0.0)

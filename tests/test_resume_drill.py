@@ -113,6 +113,62 @@ class TestNetworkLevelResume:
                                np.asarray(ref.params()), rtol=1e-6)
 
 
+class TestPreemptionDrill:
+    """Network-level preemption (ISSUE 2): SIGTERM mid-fit must flush a
+    checkpoint at the exact batch boundary, and resuming from it must be
+    bit-identical to the uninterrupted run — the fit-loop analog of the
+    process-kill drills below, driven by the guardian's SIGTERM hook."""
+
+    def test_sigterm_mid_fit_resume_is_bit_identical(self, tmp_path):
+        import os as _os
+        import signal as _signal
+
+        from deeplearning4j_tpu.datasets import ListDataSetIterator
+        from deeplearning4j_tpu.optimize.guardian import TrainingPreempted
+
+        n_batches, bs, kill_after = 8, 24, 3
+        batches = _batches(n_batches, bs)
+        x = np.concatenate([bx for bx, _ in batches])
+        y = np.concatenate([by for _, by in batches])
+
+        # uninterrupted reference over the identical iterator stream
+        ref = MultiLayerNetwork.from_config_json(_conf().to_json())
+        ref.fit(ListDataSetIterator(DataSet(x, y), bs))
+        ref_params = np.asarray(ref.params())
+
+        class KillAt:
+            """Delivers a real SIGTERM after batch `kill_after` — the
+            guardian handler defers it to the step boundary."""
+
+            def __init__(self, at):
+                self.at = at
+                self.count = 0
+
+            def iteration_done(self, model, iteration, score):
+                self.count += 1
+                if self.count == self.at + 1:
+                    _os.kill(_os.getpid(), _signal.SIGTERM)
+
+        path = str(tmp_path / "preempt.ckpt")
+        net = MultiLayerNetwork.from_config_json(_conf().to_json())
+        net.set_listeners([KillAt(kill_after)])
+        with pytest.raises(TrainingPreempted) as exc:
+            net.fit(ListDataSetIterator(DataSet(x, y), bs),
+                    saver=DefaultModelSaver(path, keep_old=False))
+        assert exc.value.path == path
+        assert exc.value.position == kill_after + 1
+        del net  # the VM is gone
+
+        # fresh process: restore and continue the remaining stream
+        net2, info = load_checkpoint(path)
+        pos = info["iterator_position"]
+        assert pos == kill_after + 1
+        assert net2._updater_state is not None
+        net2.fit(ListDataSetIterator(DataSet(x[pos * bs:], y[pos * bs:]),
+                                     bs))
+        np.testing.assert_array_equal(np.asarray(net2.params()), ref_params)
+
+
 def _jobs(n=8, bs=24, seed=1):
     return [DataSet(bx, by) for bx, by in _batches(n, bs, seed)]
 
