@@ -454,6 +454,86 @@ def bench_guardian():
             "steps_per_window": steps, "window_s": round(win_s, 3)}
 
 
+def bench_serve():
+    """Serving config (docs/SERVING.md): (a) InferenceEngine throughput
+    + p50/p99 latency over a synthetic RAGGED request stream — per-
+    request eager dispatch is part of the metric (it is what serving
+    pays per call), with the program-cache counter as the recompile
+    guard; (b) transformer decode tokens/sec, KV-cache vs naive
+    full-recompute — the cached path must win per token."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets.mnist import synthetic_mnist
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       generate,
+                                                       init_transformer_params)
+    from deeplearning4j_tpu.serving.engine import InferenceEngine
+
+    fast = _fast()
+
+    # ---- (a) ragged request stream through one engine
+    net, _ = _mlp_net()
+    max_batch = 64 if fast else 256
+    engine = InferenceEngine.for_network(net, max_batch_size=max_batch)
+    engine.warmup((784,))
+    programs_after_warmup = engine.program_cache_size()
+    rng = np.random.RandomState(0)
+    n_requests = 24 if fast else 200
+    sizes = rng.randint(1, max_batch + 1, size=n_requests)
+    x_all, _ = synthetic_mnist(int(sizes.max()))
+    requests = [x_all[:s] for s in sizes]
+    total_rows = int(sizes.sum())
+
+    def window():
+        for req in requests:
+            engine.infer(req)  # np.asarray inside = per-request D2H
+
+    rows_rate, win_s = _median_rate(window, total_rows)
+    programs = engine.program_cache_size()
+    counters_ok = programs >= 0 and programs_after_warmup >= 0
+    snap = engine.snapshot()
+
+    # ---- (b) decode tokens/sec: KV cache vs naive full-recompute
+    cfg = TransformerConfig(vocab_size=512, d_model=128, n_heads=4,
+                            n_layers=2, d_ff=256,
+                            max_len=64 if fast else 512,
+                            interpret=fast)
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+    b, t0 = 4, 16
+    n_tok = (16 if fast else 128)
+    prompt = jnp.asarray(
+        np.random.RandomState(1).randint(0, cfg.vocab_size, (b, t0)),
+        jnp.int32)
+
+    def decode_window(cache):
+        def run():
+            _d2h(generate(params, prompt, cfg, n_tok, cache=cache))
+        run()  # compile
+        rate, _ = _median_rate(run, b * n_tok)
+        return rate
+
+    tok_naive = decode_window(False)
+    tok_cached = decode_window(True)
+
+    return {"value": round(tok_cached, 2), "unit": "tokens/sec_cached",
+            "decode": {"tokens_per_sec_cached": round(tok_cached, 2),
+                       "tokens_per_sec_naive": round(tok_naive, 2),
+                       "cache_speedup": round(tok_cached / tok_naive, 2),
+                       "batch": b, "prompt_len": t0, "n_tokens": n_tok},
+            "engine": {"rows_per_sec": round(rows_rate, 2),
+                       "requests": n_requests,
+                       "latency_p50_ms": snap["latency_p50_ms"],
+                       "latency_p99_ms": snap["latency_p99_ms"],
+                       "occupancy": round(snap["occupancy"], 4),
+                       "compiled_programs":
+                           programs if counters_ok else None,
+                       "recompiled_after_warmup":
+                           (programs - programs_after_warmup)
+                           if counters_ok else None},
+            "window_s": round(win_s, 3)}
+
+
 def _flash_inputs():
     import jax
     import jax.numpy as jnp
@@ -554,6 +634,7 @@ CONFIGS = {
     "mlp": bench_mlp,
     "feed": bench_feed,
     "guardian": bench_guardian,
+    "serve": bench_serve,
     "lenet": bench_lenet,
     "dbn": bench_dbn,
     "word2vec": bench_word2vec,
@@ -566,6 +647,7 @@ METRIC_NAMES = {
     "mlp": "mlp_mnist_train_samples_per_sec_per_chip",
     "feed": "device_feed_ragged_stream_steps_per_sec",
     "guardian": "guardian_guarded_step_time_ms",
+    "serve": "serving_decode_tokens_per_sec_cached",
     "lenet": "lenet_mnist_step_time_ms",
     "dbn": "dbn_pretrain_finetune_samples_per_sec_per_chip",
     "word2vec": "word2vec_skipgram_pairs_per_sec",

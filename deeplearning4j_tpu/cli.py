@@ -1,15 +1,17 @@
-"""CLI: train / test / predict subcommands.
+"""CLI: train / test / predict / serve subcommands.
 
 Parity: reference deeplearning4j-cli — args4j subcommands `Train`/`Test`/
 `Predict` with --input/--model/--output flags (cli/subcommands/Train.java:31
 — whose `exec()` is an EMPTY STUB :46; this implementation does what it
 advertised) and the URI-scheme input dispatch of cli/api/flags/Input.java
-(here: .csv vs .ckpt vs .npz by extension).
+(here: .csv vs .ckpt vs .npz by extension). `serve` is beyond-parity:
+the online endpoint over serving/ (docs/SERVING.md).
 
 Usage:
     python -m deeplearning4j_tpu.cli train   -i data.csv -m conf.json -o model.ckpt
     python -m deeplearning4j_tpu.cli test    -i data.csv -m model.ckpt
     python -m deeplearning4j_tpu.cli predict -i data.csv -m model.ckpt -o preds.csv
+    python -m deeplearning4j_tpu.cli serve   -m model.ckpt --port 8000
 
 Input CSV: one row per example, features then (for train/test) one-hot or
 integer label in the last column(s) — controlled by --label-columns.
@@ -117,6 +119,31 @@ def cmd_predict(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    from deeplearning4j_tpu.serving.server import serve_network
+
+    net = _load_model(args.model)
+    n_in = net.conf.confs[0].n_in
+    handle = serve_network(
+        net, host=args.host, port=args.port, n_replicas=args.replicas,
+        max_batch_size=args.max_batch_size, max_delay_ms=args.max_delay_ms,
+        warmup_shape=(n_in,) if (args.warmup and n_in) else None)
+    print(json.dumps({"serving": handle.url,
+                      "replicas": len(handle.replicas.engines),
+                      "max_batch_size": args.max_batch_size,
+                      "max_delay_ms": args.max_delay_ms}), flush=True)
+    if args.smoke:  # start/stop sanity check (tests, deploy probes)
+        handle.close()
+        return 0
+    try:
+        handle.http.thread.join()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        handle.close()
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="deeplearning4j_tpu",
@@ -145,6 +172,26 @@ def build_parser() -> argparse.ArgumentParser:
     p_pred = sub.add_parser("predict", help="emit class predictions")
     common(p_pred, False)
     p_pred.set_defaults(fn=cmd_predict, label_columns=0)
+
+    p_serve = sub.add_parser(
+        "serve", help="serve a model over HTTP (docs/SERVING.md)")
+    p_serve.add_argument("--model", "-m", required=True,
+                         help="conf .json (fresh net) or .ckpt checkpoint")
+    p_serve.add_argument("--host", default="127.0.0.1")
+    p_serve.add_argument("--port", type=int, default=0,
+                         help="0 = auto-assign (printed on start)")
+    p_serve.add_argument("--replicas", type=int, default=None,
+                         help="device replicas (default: all local)")
+    p_serve.add_argument("--max-batch-size", type=int, default=64,
+                         help="micro-batcher coalescing cap / top bucket")
+    p_serve.add_argument("--max-delay-ms", type=float, default=2.0,
+                         help="micro-batcher coalescing window")
+    p_serve.add_argument("--no-warmup", dest="warmup",
+                         action="store_false",
+                         help="skip precompiling the bucket programs")
+    p_serve.add_argument("--smoke", action="store_true",
+                         help="start, print the address, shut down")
+    p_serve.set_defaults(fn=cmd_serve)
     return parser
 
 

@@ -14,6 +14,7 @@ from deeplearning4j_tpu.datasets.device_feed import (  # noqa: F401
     DeviceFeed,
     FeedBatch,
     bucket_for,
+    pad_rows,
     pow2_buckets,
 )
 from deeplearning4j_tpu.datasets.mnist import (  # noqa: F401

@@ -36,7 +36,7 @@ from typing import Any, Iterator, NamedTuple, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = ["FeedBatch", "DeviceFeed", "feed_mask", "pow2_buckets",
-           "bucket_for"]
+           "bucket_for", "pad_rows"]
 
 
 def feed_mask(n_rows: int, n_valid):
@@ -88,6 +88,23 @@ def bucket_for(n: int, buckets: Sequence[int]) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def pad_rows(arr, bucket: int):
+    """Zero-pad `arr`'s leading dim up to `bucket` (no-op when already
+    there). The inference-side twin of DeviceFeed._pad: forwards are
+    per-row independent, so padded rows just get sliced off the output —
+    no mask threading needed."""
+    n = arr.shape[0]
+    if n == bucket:
+        return arr
+    if n > bucket:
+        raise ValueError(f"batch of {n} rows exceeds bucket {bucket}")
+    import jax.numpy as jnp
+
+    return jnp.concatenate(
+        [jnp.asarray(arr),
+         jnp.zeros((bucket - n, *arr.shape[1:]), arr.dtype)])
 
 
 class FeedBatch(NamedTuple):

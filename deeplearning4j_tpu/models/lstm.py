@@ -127,6 +127,61 @@ class LSTM(BaseLayer):
             out = jax.nn.softmax(out, axis=-1)
         return loss_fn(self.conf.loss_function)(labels, out, weights)
 
+    # --------------------------------------------------------- streaming
+    def _ensure_infer_jits(self) -> None:
+        """Build the cached inference programs once per layer instance.
+        params are TRACED arguments, so repeated predict()/run_stream()
+        calls (and params updates between them) reuse one compiled
+        program per input shape instead of re-tracing a fresh closure
+        every call."""
+        if getattr(self, "_tick_jit", None) is not None:
+            return
+
+        def tick(params, x_t, h, c):
+            h_new, c_new = self.cell(params, x_t[None, :], h[None, :],
+                                     c[None, :])
+            y = h_new @ params["Wd"] + params["bd"]
+            return y[0], h_new[0], c_new[0]
+
+        def stream(params, x, h0, c0):
+            def one(x_seq, h0, c0):
+                def step(carry, x_t):
+                    h, c = carry
+                    h, c = self.cell(params, x_t, h, c)
+                    return (h, c), h
+
+                (h, c), hs = lax.scan(step, (h0, c0), x_seq)
+                return hs, (h, c)
+
+            if x.ndim == 3:
+                hs, carry = jax.vmap(one)(x, h0, c0)
+            else:
+                hs, carry = one(x, h0, c0)
+            return hs @ params["Wd"] + params["bd"], carry
+
+        self._tick_jit = jax.jit(tick)
+        self._stream_jit = jax.jit(stream)
+
+    def run_stream(self, params, x, carry=None):
+        """Decoded outputs AND the final recurrent state, as one
+        compiled `lax.scan` step: x (T, n_in) or (B, T, n_in) ->
+        (outputs matching activate(), (h, c) carry). Feed the returned
+        carry back as `carry=` to continue a stream across calls —
+        the chunked/streaming inference primitive (same cell math as
+        activate, which always starts from zeros)."""
+        d, _ = self._dims()
+        x = jnp.asarray(x)
+        if x.ndim not in (2, 3):
+            raise ValueError(
+                f"run_stream expects (T, n_in) or (B, T, n_in), got "
+                f"shape {x.shape}")
+        if carry is None:
+            lead = x.shape[:-2]
+            zeros = jnp.zeros((*lead, d), x.dtype)
+            carry = (zeros, zeros)
+        self._ensure_infer_jits()
+        return self._stream_jit(params, x, carry[0], carry[1])
+
     # ---------------------------------------------------------- decoding
     def predict(self, params, x_init: jnp.ndarray, ws: jnp.ndarray,
                 beam_size: int = 5, n_steps: int = 20,
@@ -134,18 +189,16 @@ class LSTM(BaseLayer):
         """Beam-search decode (reference predict :234 + BeamSearch :256).
 
         `x_init`: (n_in,) start input; `ws`: (vocab, n_in) token embeddings.
-        Returns [(token ids, log prob)] sorted best-first. The per-step cell
-        is jitted; the beam bookkeeping is host-side (data-dependent beam
-        contents don't belong inside jit).
+        Returns [(token ids, log prob)] sorted best-first. The per-step
+        cell is the cached compiled tick (params traced — one program
+        across predict calls); the beam bookkeeping is host-side
+        (data-dependent beam contents don't belong inside jit).
         """
         d, _ = self._dims()
+        self._ensure_infer_jits()
 
-        @jax.jit
         def tick(x_t, h, c):
-            h_new, c_new = self.cell(params, x_t[None, :], h[None, :],
-                                     c[None, :])
-            y = h_new @ params["Wd"] + params["bd"]
-            return y[0], h_new[0], c_new[0]
+            return self._tick_jit(params, x_t, h, c)
 
         zeros = jnp.zeros((d,), x_init.dtype)
         # Seed the beams from the model's prediction AFTER x_init: the first

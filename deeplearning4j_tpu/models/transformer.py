@@ -179,12 +179,25 @@ def fit_scan(params, tokens_batches, cfg: TransformerConfig,
     return run(params, init_velocity(params), tokens_batches, int(epochs))
 
 
-def generate(params, prompt, cfg: TransformerConfig, n_tokens: int):
-    """Greedy decoding (full-recompute per step — the parity demo form,
-    not a KV-cache server): prompt (B, T0) -> (B, T0 + n_tokens)."""
+def generate(params, prompt, cfg: TransformerConfig, n_tokens: int,
+             cache: bool = False):
+    """Greedy decoding: prompt (B, T0) -> (B, T0 + n_tokens).
+
+    `cache=True` routes through the preallocated KV cache
+    (serving/kv_cache.py): prefill once, then O(1) decode steps inside
+    one compiled scan — the serving path, parity-tested against the
+    naive form below. `cache=False` keeps the full-recompute demo form
+    (every step re-runs the whole prefix)."""
     b, t0 = prompt.shape
     if t0 + n_tokens > cfg.max_len:
         raise ValueError("generation would exceed max_len")
+    if cache:
+        if n_tokens < 1:
+            raise ValueError(f"n_tokens must be >= 1, got {n_tokens}")
+        # deferred import: serving builds on this module
+        from deeplearning4j_tpu.serving.kv_cache import generate_cached
+        return generate_cached(params, jnp.asarray(prompt, jnp.int32),
+                               cfg, int(n_tokens))
     buf = jnp.zeros((b, t0 + n_tokens), jnp.int32).at[:, :t0].set(prompt)
 
     def step(buf, i):

@@ -26,13 +26,16 @@ import numpy as np
 from jax.flatten_util import ravel_pytree
 
 from deeplearning4j_tpu.config.multi_layer_configuration import MultiLayerConfiguration
-from deeplearning4j_tpu.datasets.device_feed import DeviceFeed, feed_mask
+from deeplearning4j_tpu.datasets.device_feed import (DEFAULT_MIN_BUCKET,
+                                                     DeviceFeed, bucket_for,
+                                                     feed_mask, pad_rows)
 from deeplearning4j_tpu.nn.api import merge_params
 from deeplearning4j_tpu.nn.layers import make_layer
 from deeplearning4j_tpu.optimize.guardian import (GuardianAbort,
                                                   guarded_update, make_guard)
 from deeplearning4j_tpu.optimize.solver import Solver
 from deeplearning4j_tpu.optimize.updater import NetworkGradientUpdater
+from deeplearning4j_tpu.utils.jitcache import jit_cache_size
 from deeplearning4j_tpu.utils.sanitize import validate_batch
 
 log = logging.getLogger(__name__)
@@ -52,6 +55,7 @@ class MultiLayerNetwork:
         self._updater_state = None
         self._train_step = None
         self._train_step_guarded = None
+        self._predict_step = None
         self._finetune_solver = None
         self._batch_solver = None
         self._scan_steps: Dict[tuple, object] = {}
@@ -91,6 +95,7 @@ class MultiLayerNetwork:
         self._updater_state = None
         self._train_step = None
         self._train_step_guarded = None
+        self._predict_step = None
         self._finetune_solver = None
         self._batch_solver = None
         self._scan_steps = {}
@@ -647,10 +652,10 @@ class MultiLayerNetwork:
         for step in (self._train_step, self._train_step_guarded):
             if step is None:
                 continue
-            try:
-                total += int(step._cache_size())
-            except AttributeError:  # pragma: no cover — jax internals moved
+            size = jit_cache_size(step)
+            if size < 0:
                 return -1
+            total += size
         return total
 
     def finetune(self, x, labels=None) -> None:
@@ -703,13 +708,48 @@ class MultiLayerNetwork:
                        context="feed_forward")
         return self.feed_forward_fn(self._params, x)
 
-    def output(self, x) -> jnp.ndarray:
-        """Output-layer activations (reference output :1197)."""
-        return self.feed_forward(x)[-1]
+    def _get_predict_step(self):
+        """Cached jitted forward to the output layer — the serving-side
+        twin of _get_train_step. Input batches pad to a pow2 bucket
+        before the call (see output), so a ragged request/CSV stream
+        compiles <= one program per bucket instead of one per shape."""
+        if self._predict_step is None:
+            self._predict_step = jax.jit(
+                lambda params, x: self.feed_forward_fn(params, x)[-1])
+        return self._predict_step
+
+    def output(self, x, bucketed: bool = True) -> jnp.ndarray:
+        """Output-layer activations (reference output :1197).
+
+        `bucketed=True` (default) zero-pads the batch up to the pow2
+        bucket ladder and runs the cached jitted forward, slicing the
+        padding back off — inference is per-row independent, so padded
+        rows never touch real outputs. `bucketed=False` is the eager
+        legacy path (also the escape hatch for layers with
+        cross-example behavior at inference)."""
+        if not bucketed:
+            return self.feed_forward(x)[-1]
+        x = jnp.asarray(x)
+        validate_batch(x, n_in=self.layers[0].conf.n_in
+                       if not self.conf.input_preprocessors.get(0) else None,
+                       context="output")
+        n = x.shape[0]
+        b = bucket_for(n, (DEFAULT_MIN_BUCKET,))
+        return self._get_predict_step()(self._params, pad_rows(x, b))[:n]
 
     def predict(self, x) -> np.ndarray:
-        """Class predictions (reference predict :1107)."""
+        """Class predictions (reference predict :1107) — through the
+        bucketed jitted forward."""
         return np.asarray(jnp.argmax(self.output(x), axis=-1))
+
+    def predict_step_cache_size(self) -> int:
+        """Compiled-program count for the jitted inference forward (the
+        train_step_cache_size analogue): with bucketing this stays at
+        the pow2 buckets actually hit, not one per batch shape. 0 before
+        the first bucketed output/predict."""
+        if self._predict_step is None:
+            return 0
+        return jit_cache_size(self._predict_step)
 
     def score(self, x, labels) -> float:
         """Mean loss on (x, labels) (reference score :1265)."""

@@ -10,11 +10,12 @@ page at /.
 from __future__ import annotations
 
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional, Sequence
+from http.server import BaseHTTPRequestHandler
+from typing import Optional, Sequence
 
 import numpy as np
+
+from deeplearning4j_tpu.utils.httpd import ServerHandle, start_http_server
 
 _PAGE = b"""<!doctype html><html><body>
 <canvas id=c width=900 height=900></canvas><script>
@@ -31,9 +32,11 @@ fetch('/api/coords').then(r=>r.json()).then(d=>{
 
 
 def serve_coords(coords: np.ndarray, labels: Optional[Sequence[str]] = None,
-                 port: int = 0):
-    """Start the render server (daemon thread); returns (server, port).
-    Call server.shutdown() to stop."""
+                 port: int = 0) -> ServerHandle:
+    """Start the render server (daemon thread) on an auto-assigned port
+    by default; returns a ServerHandle — call handle.close() to stop and
+    release the socket (it also unpacks as the historical
+    (server, port) pair)."""
     coords = np.asarray(coords, np.float64)
     payload = json.dumps({
         "coords": coords[:, :2].tolist(),
@@ -56,7 +59,4 @@ def serve_coords(coords: np.ndarray, labels: Optional[Sequence[str]] = None,
         def log_message(self, *args):  # quiet
             pass
 
-    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-    thread = threading.Thread(target=server.serve_forever, daemon=True)
-    thread.start()
-    return server, server.server_address[1]
+    return start_http_server(Handler, port=port)

@@ -1,0 +1,27 @@
+"""Inference serving: compiled engines, dynamic micro-batching, KV-cache
+decode, and multi-replica dispatch behind a stdlib HTTP front end.
+
+The training side compiles one program per shape bucket and keeps the
+host off the critical path (datasets/device_feed.py); this package
+applies the same discipline to the inference workload: an
+`InferenceEngine` holds one jitted forward per bucket, a `MicroBatcher`
+coalesces concurrent requests into those buckets, `KVCache` makes
+autoregressive decode O(1) per token, and a `ReplicaSet` round-robins
+engines across local devices. See docs/SERVING.md.
+"""
+
+from deeplearning4j_tpu.serving.batcher import MicroBatcher  # noqa: F401
+from deeplearning4j_tpu.serving.engine import (  # noqa: F401
+    EngineStats,
+    InferenceEngine,
+)
+from deeplearning4j_tpu.serving.kv_cache import (  # noqa: F401
+    KVCache,
+    decode_step,
+    generate_cached,
+    init_cache,
+    kv_cache_bytes,
+    prefill,
+)
+from deeplearning4j_tpu.serving.replicas import ReplicaSet  # noqa: F401
+from deeplearning4j_tpu.serving.server import serve_network  # noqa: F401

@@ -1,0 +1,218 @@
+"""Dynamic micro-batcher: coalesce concurrent requests into one batch.
+
+Single-row requests waste a parallel chip; the batcher sits in front of
+an engine (or replica set) and merges whatever arrives within a
+`max_delay_ms` window — up to `max_batch_size` rows — into ONE forward,
+then scatters the output rows back to per-request futures.
+
+Contract:
+
+- `submit(x)` is thread-safe and returns a `concurrent.futures.Future`
+  whose result has the same leading dim as `x` (a 1-D request is
+  treated as one row and resolves to a (1, ...) result). Rows map back
+  in submit order — coalescing never reorders or mixes rows between
+  requests.
+- **Per-request error isolation**: a request whose feature shape
+  disagrees with its batch-mates fails alone (its future gets the
+  ValueError); the rest of the batch still runs. A failure of the
+  engine call itself fails only the futures in that batch — the worker
+  survives and keeps serving subsequent batches.
+- A request that would overflow `max_batch_size` is held for the next
+  batch (never split across two forwards), so one future always maps to
+  one contiguous row range of one engine call.
+- `close()` stops accepting submits, flushes everything already queued,
+  and joins the worker. Also usable as a context manager.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, NamedTuple, Optional
+
+import numpy as np
+
+__all__ = ["MicroBatcher"]
+
+_CLOSE = object()
+
+
+class _Request(NamedTuple):
+    x: np.ndarray
+    future: Future
+
+
+def _resolve(fut: Future, value=None, exc: Optional[BaseException] = None
+             ) -> None:
+    """set_result/set_exception tolerating a caller-cancelled future —
+    a client giving up (fut.cancel() after a result timeout) must never
+    kill the worker thread."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(value)
+    except Exception:  # InvalidStateError: cancelled/already done
+        pass
+
+
+class MicroBatcher:
+    def __init__(self, run_batch: Callable[[np.ndarray], np.ndarray], *,
+                 max_batch_size: int = 64, max_delay_ms: float = 2.0,
+                 name: str = "micro-batcher"):
+        if max_batch_size < 1:
+            raise ValueError(
+                f"max_batch_size must be >= 1, got {max_batch_size}")
+        if max_delay_ms < 0:
+            raise ValueError(
+                f"max_delay_ms must be >= 0, got {max_delay_ms}")
+        self._run = run_batch
+        self.max_batch_size = int(max_batch_size)
+        self.max_delay_s = max_delay_ms / 1000.0
+        self._q: "queue.Queue" = queue.Queue()
+        self._closed = False
+        self._lock = threading.Lock()
+        # counters (worker-thread writes, snapshot reads under lock)
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.batches = 0
+        self.batched_rows = 0
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name=name)
+        self._worker.start()
+
+    # ----------------------------------------------------------- submit
+    def submit(self, x) -> Future:
+        """Enqueue one request; the future resolves to the engine output
+        rows for exactly these input rows."""
+        fut: Future = Future()
+        arr = np.asarray(x)
+        if arr.ndim == 0:
+            fut.set_exception(ValueError("scalar request"))
+            return fut
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        if arr.shape[0] == 0:
+            fut.set_exception(ValueError("empty request"))
+            return fut
+        with self._lock:
+            if self._closed:
+                fut.set_exception(RuntimeError("batcher is closed"))
+                return fut
+            self.submitted += 1
+            # enqueue under the lock: close() also takes it before
+            # putting the sentinel, so no request can land AFTER _CLOSE
+            # and strand its future in a dead queue
+            self._q.put(_Request(arr, fut))
+        return fut
+
+    # ----------------------------------------------------------- worker
+    def _coalesce(self, first: _Request):
+        """Collect batch-mates for up to max_delay_s; returns
+        (requests, leftover-or-sentinel)."""
+        batch = [first]
+        rows = first.x.shape[0]
+        deadline = time.monotonic() + self.max_delay_s
+        while rows < self.max_batch_size:
+            timeout = deadline - time.monotonic()
+            if timeout <= 0:
+                break
+            try:
+                item = self._q.get(timeout=timeout)
+            except queue.Empty:
+                break
+            if item is _CLOSE:
+                return batch, _CLOSE
+            if rows + item.x.shape[0] > self.max_batch_size:
+                return batch, item  # hold for the next batch, unsplit
+            batch.append(item)
+            rows += item.x.shape[0]
+        return batch, None
+
+    def _run_group(self, batch) -> None:
+        # per-request validation against the batch's first request: a
+        # mismatched request fails alone, the rest still run
+        tail = batch[0].x.shape[1:]
+        good, offsets, rows = [], [], 0
+        for req in batch:
+            if req.x.shape[1:] != tail:
+                _resolve(req.future, exc=ValueError(
+                    f"request feature shape {req.x.shape[1:]} does not "
+                    f"match batch feature shape {tail}"))
+                with self._lock:
+                    self.failed += 1
+                continue
+            good.append(req)
+            offsets.append(rows)
+            rows += req.x.shape[0]
+        if not good:
+            return
+        features = (good[0].x if len(good) == 1
+                    else np.concatenate([r.x for r in good]))
+        try:
+            out = np.asarray(self._run(features))
+        except Exception as e:
+            # batch-level failure: poison only THIS batch's futures
+            for req in good:
+                _resolve(req.future, exc=e)
+            with self._lock:
+                self.failed += len(good)
+            return
+        with self._lock:
+            self.batches += 1
+            self.batched_rows += rows
+            self.completed += len(good)
+        for req, off in zip(good, offsets):
+            _resolve(req.future, out[off:off + req.x.shape[0]])
+
+    def _loop(self) -> None:
+        pending: Optional[_Request] = None
+        while True:
+            if pending is not None:
+                first, pending = pending, None
+            else:
+                first = self._q.get()
+            if first is _CLOSE:
+                return
+            batch, leftover = self._coalesce(first)
+            self._run_group(batch)
+            if leftover is _CLOSE:
+                return
+            pending = leftover
+
+    # -------------------------------------------------------- lifecycle
+    def close(self, timeout: float = 10.0) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            # sentinel goes in under the same lock submit holds, so it
+            # is strictly LAST: everything submitted before it flushes
+            self._q.put(_CLOSE)
+        self._worker.join(timeout=timeout)
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ stats
+    def snapshot(self) -> dict:
+        with self._lock:
+            per_batch = (self.batched_rows / self.batches
+                         if self.batches else 0.0)
+            return {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "failed": self.failed,
+                "batches": self.batches,
+                "mean_rows_per_batch": round(per_batch, 2),
+                "occupancy": round(per_batch / self.max_batch_size, 4),
+                "queue_depth": self._q.qsize(),
+                "max_batch_size": self.max_batch_size,
+                "max_delay_ms": self.max_delay_s * 1000.0,
+            }

@@ -1,0 +1,232 @@
+"""InferenceEngine: compile-once-per-bucket forward for serving.
+
+The training side already learned this lesson (datasets/device_feed.py):
+a jitted program re-specializes per input shape, so ragged traffic must
+be padded onto a small bucket ladder. An engine owns ONE jitted apply
+function and the bucket ladder for its model; every request pads up to
+the smallest bucket that holds it and slices the padding back off the
+result. Since the forward is per-row independent (no cross-example
+reductions at inference), padded rows never touch real outputs — no
+mask needed, unlike the training loss.
+
+The request input buffer is donated to the jitted call (it is freshly
+device_put per request, so XLA reuses its HBM for the activations);
+params are NOT donated — they serve every request.
+
+Observability is first-class (`EngineStats`): requests, rows, batch
+occupancy, p50/p99 wall latency (each timed window ends with the D2H
+read of the result — the honest protocol from BASELINE.md), and the
+program-cache counter that pins "ragged stream compiles <= one program
+per bucket" in tests and bench.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.device_feed import (DEFAULT_MIN_BUCKET,
+                                                     bucket_for,
+                                                     pow2_buckets)
+from deeplearning4j_tpu.utils.jitcache import jit_cache_size
+
+__all__ = ["EngineStats", "InferenceEngine"]
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class EngineStats:
+    """Thread-safe per-engine counters + a bounded latency reservoir."""
+
+    def __init__(self, window: int = 2048):
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.rows = 0
+        self.padded_rows = 0
+        self.errors = 0
+        self._latencies = deque(maxlen=window)
+
+    def record(self, rows: int, bucket: int, seconds: float) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows += rows
+            self.padded_rows += bucket - rows
+            self._latencies.append(seconds)
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            lat = sorted(self._latencies)
+            shipped = self.rows + self.padded_rows
+            return {
+                "requests": self.requests,
+                "rows": self.rows,
+                "padded_rows": self.padded_rows,
+                "errors": self.errors,
+                # fraction of shipped rows that were real work
+                "occupancy": (self.rows / shipped) if shipped else 0.0,
+                "latency_p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+                "latency_p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+            }
+
+
+class InferenceEngine:
+    """A jitted, bucket-padded forward for one model on one device.
+
+    `apply_fn(params, x)` must be a pure per-row forward; `x`'s leading
+    dim is the batch. Construct via the classmethods for the stock
+    model families, or directly for anything functional.
+    """
+
+    def __init__(self, apply_fn: Callable, params, *,
+                 max_batch_size: int = 64,
+                 buckets: Optional[Sequence[int]] = None,
+                 min_bucket: int = DEFAULT_MIN_BUCKET,
+                 device=None,
+                 generate_fn: Optional[Callable] = None):
+        import jax
+
+        if buckets is None:
+            buckets = pow2_buckets(max_batch_size, min_bucket=min_bucket)
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"buckets must be positive, got {buckets}")
+        self.max_batch_size = int(max_batch_size)
+        self.device = device
+        self._params = (jax.device_put(params, device)
+                        if device is not None else params)
+        # donate the request buffer (engine-owned: infer stages through
+        # host + device_put, never the caller's array) so its HBM is
+        # reused for activations; CPU ignores donation with a warning,
+        # so gate it off there
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        self._jit = jax.jit(apply_fn, donate_argnums=donate)
+        self._generate_fn = generate_fn
+        self.stats = EngineStats()
+
+    # ----------------------------------------------------- constructors
+    @classmethod
+    def for_network(cls, net, **kw) -> "InferenceEngine":
+        """Wrap a MultiLayerNetwork: apply = output-layer activations
+        (the bucketed twin of `net.output`)."""
+        return cls(lambda p, x: net.feed_forward_fn(p, x)[-1],
+                   net.param_table, **kw)
+
+    @classmethod
+    def for_transformer(cls, params, cfg, **kw) -> "InferenceEngine":
+        """Wrap a transformer LM: apply = full logits (B, T, vocab);
+        `generate()` runs the KV-cached decode loop."""
+        from deeplearning4j_tpu.models.transformer import transformer_logits
+        from deeplearning4j_tpu.serving.kv_cache import generate_cached
+
+        return cls(lambda p, tok: transformer_logits(p, tok, cfg), params,
+                   generate_fn=lambda p, prompt, n: generate_cached(
+                       p, prompt, cfg, n),
+                   **kw)
+
+    @classmethod
+    def for_lstm(cls, layer, params, **kw) -> "InferenceEngine":
+        """Wrap an LSTM layer: apply = per-timestep decoded outputs over
+        (B, T, n_in) input."""
+        return cls(lambda p, x: layer.activate(p, x), params, **kw)
+
+    # ------------------------------------------------------------ serve
+    def infer(self, x) -> np.ndarray:
+        """One request: (n, ...) -> np.ndarray of the first n output
+        rows. Pads n up to the bucket ladder (requests beyond the top
+        bucket take the pow2 escape ladder, still bounding program
+        count), runs the compiled forward, slices the padding off. The
+        returned array is host-resident — the D2H read is inside the
+        latency window.
+
+        Input is staged through the host (np.asarray + device_put), so
+        the device buffer handed to the donated jit arg is always
+        engine-owned — a caller's device array is never invalidated."""
+        import jax
+
+        x = np.asarray(x)
+        if x.ndim < 2:
+            raise ValueError(
+                f"infer expects a (n, ...) batch, got shape {x.shape}")
+        n = int(x.shape[0])
+        if n == 0:
+            raise ValueError("empty request")
+        start = time.perf_counter()
+        try:
+            b = bucket_for(n, self.buckets)
+            if b != n:  # pad on host — the H2D copy ships once
+                x = np.concatenate(
+                    [x, np.zeros((b - n, *x.shape[1:]), x.dtype)])
+            xb = jax.device_put(x, self.device)
+            out = np.asarray(self._jit(self._params, xb)[:n])
+        except Exception:
+            self.stats.record_error()
+            raise
+        self.stats.record(n, b, time.perf_counter() - start)
+        return out
+
+    def generate(self, prompt, n_tokens: int) -> np.ndarray:
+        """KV-cached greedy decode (transformer engines only):
+        prompt (B, T0) int tokens -> (B, T0 + n_tokens)."""
+        import jax.numpy as jnp
+
+        if self._generate_fn is None:
+            raise ValueError(
+                "this engine has no generate path (construct it with "
+                "InferenceEngine.for_transformer)")
+        prompt = jnp.asarray(prompt, jnp.int32)
+        if prompt.ndim != 2:
+            raise ValueError(
+                f"prompt must be (B, T0) tokens, got shape {prompt.shape}")
+        start = time.perf_counter()
+        try:
+            out = np.asarray(
+                self._generate_fn(self._params, prompt, int(n_tokens)))
+        except Exception:
+            self.stats.record_error()
+            raise
+        self.stats.record(int(prompt.shape[0]), int(prompt.shape[0]),
+                          time.perf_counter() - start)
+        return out
+
+    # ---------------------------------------------------- observability
+    def warmup(self, feature_shape: Sequence[int],
+               dtype=np.float32) -> None:
+        """Compile every bucket program up front so the first real
+        requests don't pay compile latency. `feature_shape` is one
+        example's shape (without the batch dim). Bypasses EngineStats —
+        warmup compiles must not pollute the serving p50/p99/occupancy
+        the bench and /stats report."""
+        import jax
+
+        for b in self.buckets:
+            xb = jax.device_put(np.zeros((b, *feature_shape), dtype),
+                                self.device)
+            np.asarray(self._jit(self._params, xb))
+
+    def program_cache_size(self) -> int:
+        """Compiled-program count for the jitted forward — the serving
+        twin of MultiLayerNetwork.train_step_cache_size(). With bucket
+        padding this stays <= len(buckets) hit (+ escape buckets);
+        -1 when the private jax counter API drifted."""
+        return jit_cache_size(self._jit)
+
+    def snapshot(self) -> dict:
+        snap = self.stats.snapshot()
+        snap["buckets"] = list(self.buckets)
+        snap["compiled_programs"] = self.program_cache_size()
+        if self.device is not None:
+            snap["device"] = str(self.device)
+        return snap

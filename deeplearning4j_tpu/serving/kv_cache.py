@@ -1,0 +1,191 @@
+"""Preallocated KV cache: O(1)-per-token transformer decode.
+
+The demo `transformer.generate` recomputes the full prefix every token —
+O(T) attention AND O(T) ffn/embedding work per emitted token. Serving
+needs the standard two-phase shape (the "portable O(1) autoregressive
+caching" design in PAPERS.md):
+
+- **prefill**: one pass over the prompt (flash attention, same math as
+  `transformer_logits`) that also writes every block's K/V into a
+  preallocated `(B, H, max_len, hd)` buffer;
+- **decode**: one token per step — project q/k/v for the single new
+  position, write k/v at the cursor, attend over the cache with a
+  `position <= cursor` mask. Per-token work no longer grows with the
+  number of generated tokens' recompute (the masked-score sweep over the
+  fixed buffer is one fused (B,H,1,L) einsum).
+
+Shapes are fixed by `cfg.max_len`, so the whole generate loop (prefill +
+`lax.scan` of decode steps) is ONE compiled program per
+(batch, prompt_len, n_tokens) signature — the cursor is a traced scalar,
+never a shape. Parity: `generate(cache=True)` matches the naive path to
+1e-5 (tests/test_serving.py) because both run the same block math; the
+only difference is exact masked softmax here vs online softmax there.
+
+Memory envelope: 2 (K and V) * n_layers * B * max_len * d_model elements
+per cache — `kv_cache_bytes` computes it; docs/SERVING.md budgets it.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.attention.blockwise import NEG_INF
+from deeplearning4j_tpu.attention.flash_pallas import flash_attention
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   _layer_norm)
+
+__all__ = ["KVCache", "init_cache", "kv_cache_bytes", "prefill",
+           "decode_step", "generate_cached"]
+
+
+class KVCache(NamedTuple):
+    """Per-block K/V buffers plus the write cursor.
+
+    `layers`: tuple (one per transformer block) of {"k", "v"} arrays of
+    shape (B, n_heads, max_len, head_dim); positions >= `cursor` are
+    unwritten zeros, masked out of every attention sweep.
+    """
+
+    layers: Tuple[Any, ...]
+    cursor: jax.Array  # int32 scalar: number of filled positions
+
+
+def init_cache(cfg: TransformerConfig, batch_size: int,
+               length: int = 0) -> KVCache:
+    """Empty cache for `batch_size` streams. `length` defaults to
+    cfg.max_len — always allocating the full window keeps decode-step
+    shapes identical across requests (one program, any prompt)."""
+    length = length or cfg.max_len
+    hd = cfg.d_model // cfg.n_heads
+    shape = (batch_size, cfg.n_heads, length, hd)
+    layers = tuple({"k": jnp.zeros(shape, cfg.dtype),
+                    "v": jnp.zeros(shape, cfg.dtype)}
+                   for _ in range(cfg.n_layers))
+    return KVCache(layers, jnp.int32(0))
+
+
+def kv_cache_bytes(cfg: TransformerConfig, batch_size: int,
+                   length: int = 0) -> int:
+    """HBM the cache pins per batch — the serving memory envelope."""
+    length = length or cfg.max_len
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    return 2 * cfg.n_layers * batch_size * length * cfg.d_model * itemsize
+
+
+def _heads(h, w, cfg: TransformerConfig):
+    b, t, d = h.shape
+    hd = d // cfg.n_heads
+    return (h @ w).reshape(b, t, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+
+
+def _ffn(p, x):
+    h = _layer_norm(p["ln2"], x)
+    return x + jax.nn.gelu(h @ p["W1"] + p["b1"]) @ p["W2"] + p["b2"]
+
+
+def prefill(params, tokens, cache: KVCache, cfg: TransformerConfig):
+    """Run the prompt (B, T0) through every block, writing K/V into the
+    cache at positions [0, T0). Returns (last-position logits (B, vocab),
+    cache with cursor=T0). Starts a fresh stream: any prior cache content
+    is overwritten from position 0."""
+    b, t0 = tokens.shape
+    x = params["embed"][tokens] + params["pos"][:t0]
+    new_layers = []
+    for p, layer in zip(params["blocks"], cache.layers):
+        h = _layer_norm(p["ln1"], x)
+        q = _heads(h, p["Wq"], cfg)
+        k = _heads(h, p["Wk"], cfg)
+        v = _heads(h, p["Wv"], cfg)
+        att = flash_attention(q, k, v, True, interpret=cfg.interpret)
+        att = att.transpose(0, 2, 1, 3).reshape(b, t0, cfg.d_model)
+        x = x + att @ p["Wo"]
+        x = _ffn(p, x)
+        new_layers.append({
+            "k": jax.lax.dynamic_update_slice(
+                layer["k"], k.astype(layer["k"].dtype), (0, 0, 0, 0)),
+            "v": jax.lax.dynamic_update_slice(
+                layer["v"], v.astype(layer["v"].dtype), (0, 0, 0, 0)),
+        })
+    x = _layer_norm(params["ln_f"], x)
+    logits = x[:, -1, :] @ params["embed"].T
+    return logits, KVCache(tuple(new_layers), jnp.int32(t0))
+
+
+def decode_step(params, token, cache: KVCache, cfg: TransformerConfig):
+    """One decode step: embed `token` (B,) at position `cache.cursor`,
+    attend over the cache, return (logits (B, vocab), advanced cache).
+    Fixed shapes throughout — the cursor is traced, so every step of
+    every request shares one compiled program."""
+    b = token.shape[0]
+    d = cfg.d_model
+    hd = d // cfg.n_heads
+    cur = cache.cursor
+    pos = jax.lax.dynamic_slice_in_dim(params["pos"], cur, 1, axis=0)
+    x = params["embed"][token][:, None, :] + pos  # (B, 1, d)
+    length = cache.layers[0]["k"].shape[2]
+    mask = jnp.arange(length) <= cur  # (L,): positions filled after write
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    new_layers = []
+    for p, layer in zip(params["blocks"], cache.layers):
+        h = _layer_norm(p["ln1"], x)
+        q = _heads(h, p["Wq"], cfg)                        # (B, H, 1, hd)
+        k_new = _heads(h, p["Wk"], cfg).astype(layer["k"].dtype)
+        v_new = _heads(h, p["Wv"], cfg).astype(layer["v"].dtype)
+        ks = jax.lax.dynamic_update_slice(layer["k"], k_new, (0, 0, cur, 0))
+        vs = jax.lax.dynamic_update_slice(layer["v"], v_new, (0, 0, cur, 0))
+        # exact masked softmax in f32 over the fixed-length buffer
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       ks.astype(jnp.float32)) * scale
+        s = jnp.where(mask[None, None, None, :], s, NEG_INF)
+        w = jax.nn.softmax(s, axis=-1)
+        att = jnp.einsum("bhqk,bhkd->bhqd", w, vs.astype(jnp.float32))
+        att = att.astype(x.dtype).transpose(0, 2, 1, 3).reshape(b, 1, d)
+        x = x + att @ p["Wo"]
+        x = _ffn(p, x)
+        new_layers.append({"k": ks, "v": vs})
+    x = _layer_norm(params["ln_f"], x)
+    logits = x[:, 0, :] @ params["embed"].T
+    return logits, KVCache(tuple(new_layers), cur + 1)
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def generate_cached(params, prompt, cfg: TransformerConfig,
+                    n_tokens: int):
+    """Greedy decode with the KV cache: prompt (B, T0) ->
+    (B, T0 + n_tokens), same contract (and same tokens, to decode-order
+    tie-breaks) as the naive `transformer.generate`. One compiled
+    program per (B, T0, n_tokens) signature; the decode loop is a
+    `lax.scan` whose body is a single O(1) step."""
+    b, t0 = prompt.shape
+    # shapes and n_tokens are static here, so these guard EVERY entry
+    # point (engine.generate, HTTP /generate) at trace time — without
+    # them an overlong decode would silently clamp the cursor into the
+    # last KV slot and emit garbage
+    if n_tokens < 1:
+        raise ValueError(f"n_tokens must be >= 1, got {n_tokens}")
+    if t0 + n_tokens > cfg.max_len:
+        raise ValueError(
+            f"generation would exceed max_len ({t0} prompt + {n_tokens} "
+            f"new > {cfg.max_len})")
+    cache = init_cache(cfg, b)
+    logits, cache = prefill(params, prompt, cache, cfg)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # token at t0
+
+    def step(carry, _):
+        cache, tok = carry
+        logits, cache = decode_step(params, tok, cache, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (cache, nxt), tok
+
+    if n_tokens == 1:
+        gen = first[:, None]
+    else:
+        (_, last), emitted = jax.lax.scan(
+            step, (cache, first), None, length=n_tokens - 1)
+        gen = jnp.concatenate(
+            [jnp.moveaxis(emitted, 0, 1), last[:, None]], axis=1)
+    return jnp.concatenate([prompt, gen], axis=1)

@@ -1,0 +1,85 @@
+"""Multi-replica dispatch: one engine per local device, round-robin.
+
+A single engine serializes on its device. For a host with several
+accelerator chips (or the 8-device virtual CPU mesh the tests run on),
+`ReplicaSet` clones the params onto each device as an independent
+`InferenceEngine` and round-robins requests across them — each replica
+compiles its own bucket programs once, and a shared `MicroBatcher` can
+sit in front so coalesced batches fan out over chips.
+
+This is intra-host scale-out; cross-host serving stacks the scaleout/
+runtime on top (each host runs its own replica set).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import List, Optional, Sequence
+
+from deeplearning4j_tpu.serving.batcher import MicroBatcher
+from deeplearning4j_tpu.serving.engine import InferenceEngine
+
+__all__ = ["ReplicaSet"]
+
+
+class ReplicaSet:
+    def __init__(self, engines: Sequence[InferenceEngine]):
+        if not engines:
+            raise ValueError("ReplicaSet needs at least one engine")
+        self.engines: List[InferenceEngine] = list(engines)
+        self._rr = itertools.cycle(self.engines)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def for_network(cls, net, n_replicas: Optional[int] = None,
+                    devices=None, **engine_kw) -> "ReplicaSet":
+        """One engine per local device (params device_put to each);
+        `n_replicas` caps how many devices are used."""
+        import jax
+
+        if devices is None:
+            devices = jax.local_devices()
+        if n_replicas is not None:
+            if n_replicas < 1:
+                raise ValueError(
+                    f"n_replicas must be >= 1, got {n_replicas}")
+            devices = devices[:n_replicas]
+        return cls([InferenceEngine.for_network(net, device=d, **engine_kw)
+                    for d in devices])
+
+    def _next(self) -> InferenceEngine:
+        with self._lock:
+            return next(self._rr)
+
+    # --------------------------------------------------------- dispatch
+    def infer(self, x):
+        return self._next().infer(x)
+
+    def generate(self, prompt, n_tokens: int):
+        return self._next().generate(prompt, n_tokens)
+
+    def warmup(self, feature_shape, **kw) -> None:
+        for engine in self.engines:
+            engine.warmup(feature_shape, **kw)
+
+    def batcher(self, **kw) -> MicroBatcher:
+        """A shared micro-batcher whose coalesced batches round-robin
+        over the replicas."""
+        return MicroBatcher(self.infer, **kw)
+
+    # ---------------------------------------------------- observability
+    def program_cache_size(self) -> int:
+        sizes = [e.program_cache_size() for e in self.engines]
+        return -1 if any(s < 0 for s in sizes) else sum(sizes)
+
+    def snapshot(self) -> dict:
+        reps = [e.snapshot() for e in self.engines]
+        return {
+            "replicas": len(self.engines),
+            "requests": sum(r["requests"] for r in reps),
+            "rows": sum(r["rows"] for r in reps),
+            "errors": sum(r["errors"] for r in reps),
+            "compiled_programs": self.program_cache_size(),
+            "per_replica": reps,
+        }

@@ -1,0 +1,187 @@
+"""Thin stdlib HTTP JSON front end over engines/replicas/batcher.
+
+Same embedded-server pattern as plot/render_server.py (and the shared
+lifecycle helper in utils/httpd.py): ThreadingHTTPServer on a daemon
+thread, port-0 auto-assign, graceful close. Endpoints:
+
+- ``POST /predict``  {"inputs": [[...], ...]} ->
+  {"outputs": [[...]...], "classes": [...]} — rows go through the
+  shared micro-batcher (coalescing concurrent clients) onto the
+  round-robin replica set.
+- ``POST /generate`` {"prompt": [[...tokens]], "n_tokens": N} ->
+  {"tokens": [[...]]} — KV-cached decode (requires a transformer
+  engine; 404 otherwise).
+- ``GET /healthz``   liveness + replica count.
+- ``GET /stats``     replica + batcher + uptime counters.
+
+This front end is deliberately minimal (stdlib only, JSON in/out, one
+process): production fronting (TLS, auth, load shedding) belongs in the
+infra layer; the contract that matters here is that everything behind
+the socket is already batched, bucketed, and compiled once per shape.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from concurrent.futures import Future
+from http.server import BaseHTTPRequestHandler
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.engine import InferenceEngine
+from deeplearning4j_tpu.serving.replicas import ReplicaSet
+from deeplearning4j_tpu.utils.httpd import ServerHandle, start_http_server
+
+__all__ = ["ServingHandle", "serve_network"]
+
+#: per-request wait on the batcher future — generous; the batcher bounds
+#: queueing at max_delay_ms, so hitting this means the engine died
+_RESULT_TIMEOUT_S = 120.0
+
+
+class ServingHandle:
+    """A running serving endpoint: http handle + batcher + replicas.
+
+    Constructed (and handed to the request handler) BEFORE the socket
+    opens — `http` is attached right after bind — so /stats is safe from
+    the first accepted connection; stats() never touches `http`.
+    """
+
+    def __init__(self, replicas: ReplicaSet, batcher,
+                 generate_engine: Optional[InferenceEngine],
+                 http: Optional[ServerHandle] = None):
+        self.http = http
+        self.replicas = replicas
+        self.batcher = batcher
+        self.generate_engine = generate_engine
+        self.started_at = time.time()
+
+    @property
+    def url(self) -> str:
+        return self.http.url
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    def close(self) -> None:
+        """Stop accepting requests, flush the batcher, release the
+        socket."""
+        self.http.close()
+        if self.batcher is not None:
+            self.batcher.close()
+
+    def __enter__(self) -> "ServingHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def stats(self) -> dict:
+        out = {"uptime_s": round(time.time() - self.started_at, 3),
+               "replicas": self.replicas.snapshot()}
+        if self.batcher is not None:
+            out["batcher"] = self.batcher.snapshot()
+        if self.generate_engine is not None:
+            out["generate"] = self.generate_engine.snapshot()
+        return out
+
+
+def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
+                  generate_engine: Optional[InferenceEngine] = None,
+                  n_replicas: Optional[int] = None,
+                  max_batch_size: int = 64, max_delay_ms: float = 2.0,
+                  host: str = "127.0.0.1", port: int = 0,
+                  warmup_shape=None) -> ServingHandle:
+    """Serve a MultiLayerNetwork (or a prebuilt ReplicaSet) over HTTP.
+
+    Pass `net` for the common case — a replica set is built across
+    local devices (capped by `n_replicas`) with `max_batch_size` as the
+    top of each engine's bucket ladder — or pass `replicas=` directly
+    for custom engines. `generate_engine` (an
+    InferenceEngine.for_transformer) enables /generate.
+    `warmup_shape` (one example's feature shape) precompiles every
+    bucket before the socket opens.
+    """
+    if replicas is None:
+        if net is None:
+            raise ValueError("serve_network needs net= or replicas=")
+        replicas = ReplicaSet.for_network(net, n_replicas=n_replicas,
+                                          max_batch_size=max_batch_size)
+    if warmup_shape is not None:
+        replicas.warmup(tuple(warmup_shape))
+    batcher = replicas.batcher(max_batch_size=max_batch_size,
+                               max_delay_ms=max_delay_ms)
+    handle = ServingHandle(replicas, batcher, generate_engine)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_json(self) -> dict:
+            length = int(self.headers.get("Content-Length") or 0)
+            if length <= 0:
+                raise ValueError("missing request body")
+            data = json.loads(self.rfile.read(length))
+            if not isinstance(data, dict):
+                raise ValueError("request body must be a JSON object")
+            return data
+
+        # ------------------------------------------------------- routes
+        def do_GET(self):
+            try:
+                if self.path.startswith("/healthz"):
+                    self._reply(200, {"ok": True,
+                                      "replicas": len(replicas.engines)})
+                elif self.path.startswith("/stats"):
+                    self._reply(200, handle.stats())
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+            except Exception as e:  # always answer with a status line
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def do_POST(self):
+            try:
+                if self.path.startswith("/predict"):
+                    self._predict()
+                elif self.path.startswith("/generate"):
+                    self._generate()
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+            except (ValueError, KeyError, TypeError) as e:
+                self._reply(400, {"error": str(e)})
+            except Exception as e:  # engine-side failure
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _predict(self):
+            data = self._read_json()
+            inputs = np.asarray(data["inputs"], np.float32)
+            fut: Future = batcher.submit(inputs)
+            out = fut.result(timeout=_RESULT_TIMEOUT_S)
+            self._reply(200, {
+                "outputs": np.asarray(out).tolist(),
+                "classes": np.argmax(out, axis=-1).astype(int).tolist(),
+            })
+
+        def _generate(self):
+            if generate_engine is None:
+                self._reply(404, {"error": "no generate engine configured"})
+                return
+            data = self._read_json()
+            prompt = np.asarray(data["prompt"], np.int64)
+            n_tokens = int(data.get("n_tokens", 16))
+            out = generate_engine.generate(prompt, n_tokens)
+            self._reply(200, {"tokens": out.astype(int).tolist()})
+
+    handle.http = start_http_server(Handler, host=host, port=port)
+    return handle

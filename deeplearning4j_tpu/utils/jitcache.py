@@ -1,0 +1,20 @@
+"""Compiled-program counting for jitted functions.
+
+The recompile-regression counters (train_step_cache_size,
+predict_step_cache_size, InferenceEngine.program_cache_size) all probe
+jax's private per-function program cache; one helper so the next jax
+rename is a one-line fix instead of a hunt."""
+
+from __future__ import annotations
+
+__all__ = ["jit_cache_size"]
+
+
+def jit_cache_size(jitted) -> int:
+    """Number of XLA programs compiled for `jitted` (a jax.jit result).
+    Returns -1 when the private jax API drifted — callers report that as
+    "counter unavailable" rather than a fake 0."""
+    try:
+        return int(jitted._cache_size())
+    except AttributeError:  # pragma: no cover — jax internals moved
+        return -1

@@ -143,7 +143,9 @@ class TestPlotter:
 class TestRenderServer:
     def test_serves_coords_json(self):
         coords = np.array([[0.0, 1.0], [2.0, 3.0]])
-        server, port = serve_coords(coords, labels=["a", "b"])
+        handle = serve_coords(coords, labels=["a", "b"])
+        server, port = handle  # historical (server, port) unpack works
+        assert port == handle.port != 0  # port-0 auto-assign
         try:
             with urllib.request.urlopen(
                     f"http://127.0.0.1:{port}/api/coords", timeout=5) as r:
@@ -154,4 +156,10 @@ class TestRenderServer:
                     f"http://127.0.0.1:{port}/", timeout=5) as r:
                 assert b"canvas" in r.read()
         finally:
-            server.shutdown()
+            handle.close()
+        # graceful shutdown released the socket AND joined the thread
+        assert not handle.thread.is_alive()
+        import socket
+        with socket.socket() as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", port))

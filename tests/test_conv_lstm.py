@@ -150,3 +150,46 @@ class TestLSTM:
         from deeplearning4j_tpu.nn.layers import make_layer
         layer = make_layer(lstm_conf())
         assert isinstance(layer, LSTM)
+
+    def test_run_stream_matches_activate_and_continues(self):
+        """The compiled streaming step: one-shot run_stream == activate,
+        and a chunked run threading the returned carry reproduces the
+        full-sequence outputs — the serve-a-stream contract."""
+        layer = LSTM(lstm_conf())
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (12, 8))
+        full, (h, c) = layer.run_stream(params, x)
+        np.testing.assert_allclose(np.asarray(full),
+                                   np.asarray(layer.activate(params, x)),
+                                   atol=1e-6)
+        assert h.shape == (8,) and c.shape == (8,)
+        out1, carry = layer.run_stream(params, x[:5])
+        out2, _ = layer.run_stream(params, x[5:], carry=carry)
+        np.testing.assert_allclose(
+            np.concatenate([np.asarray(out1), np.asarray(out2)]),
+            np.asarray(full), atol=1e-6)
+
+    def test_run_stream_batched_and_cached_programs(self):
+        """Batched (B, T, D) streaming works, and repeated calls reuse
+        the cached compiled step (params are traced args — no per-call
+        re-trace)."""
+        import pytest
+
+        layer = LSTM(lstm_conf())
+        params = layer.init_params(jax.random.PRNGKey(0))
+        xb = jax.random.normal(jax.random.PRNGKey(2), (3, 10, 8))
+        out, (h, c) = layer.run_stream(params, xb)
+        assert out.shape == (3, 10, 8) and h.shape == (3, 8)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(layer.activate(params, xb)),
+            atol=1e-6)
+        for _ in range(3):
+            layer.run_stream(params, xb)
+        assert int(layer._stream_jit._cache_size()) == 1
+        # beam-search predict shares one cached tick across calls
+        ws = jnp.eye(8)
+        layer.predict(params, ws[1], ws, beam_size=2, n_steps=3)
+        layer.predict(params, ws[2], ws, beam_size=2, n_steps=3)
+        assert int(layer._tick_jit._cache_size()) == 1
+        with pytest.raises(ValueError, match="run_stream"):
+            layer.run_stream(params, jnp.zeros((8,)))

@@ -113,6 +113,28 @@ def test_predict_returns_classes():
     assert set(np.unique(preds)).issubset({0, 1, 2})
 
 
+def test_bucketed_output_matches_eager_and_pins_programs():
+    """Serving-side twin of the train_step_cache_size pin: a ragged
+    stream of predict/output batches compiles <= one program per pow2
+    bucket (not one per shape), and bucketing never changes values."""
+    net = MultiLayerNetwork(mlp_conf())
+    rng = np.random.RandomState(0)
+    assert net.predict_step_cache_size() == 0
+    hit = set()
+    for n in (1, 3, 5, 7, 8, 9, 13, 16, 21, 100, 2, 15):
+        x = rng.rand(n, 4).astype(np.float32)
+        bucketed = np.asarray(net.output(x))
+        eager = np.asarray(net.output(x, bucketed=False))
+        np.testing.assert_allclose(bucketed, eager, atol=1e-6)
+        b = 8
+        while b < n:
+            b *= 2
+        hit.add(b)
+    programs = net.predict_step_cache_size()
+    assert programs >= 0, "jax _cache_size API drifted"
+    assert programs == len(hit)
+
+
 def test_per_layer_lr_override_honored():
     """ListBuilder.override(0, lr=0) must freeze layer 0 on the backprop
     hot path (per-layer GradientAdjustment parity)."""

@@ -1,0 +1,359 @@
+"""Serving subsystem: KV-cache parity, engine bucketing/recompile pin,
+micro-batcher ordering/timeout/error isolation, replica dispatch.
+
+The contracts under test (deeplearning4j_tpu/serving/, docs/SERVING.md):
+
+1. `generate(cache=True)` matches the naive full-recompute decode to
+   1e-5 — the KV cache changes the cost model, never the math;
+2. a ragged request stream through `InferenceEngine` compiles <= one
+   program per bucket (the program-cache counter pin);
+3. the micro-batcher coalesces concurrent requests without reordering
+   rows, flushes on max_delay_ms, and isolates per-request errors;
+4. `ReplicaSet` round-robins across engines with identical results.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   fit_scan, generate,
+                                                   init_transformer_params,
+                                                   lm_loss)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import (InferenceEngine, MicroBatcher,
+                                        ReplicaSet, init_cache,
+                                        kv_cache_bytes)
+from deeplearning4j_tpu.serving.kv_cache import decode_step, prefill
+
+CFG = TransformerConfig(vocab_size=17, d_model=32, n_heads=2, n_layers=2,
+                        d_ff=64, max_len=64, interpret=True)
+
+
+def _params(seed=0):
+    return init_transformer_params(jax.random.PRNGKey(seed), CFG)
+
+
+def _cyclic_tokens(n_batches, b, t, period=5, seed=0):
+    rng = np.random.RandomState(seed)
+    off = rng.randint(0, period, size=(n_batches, b, 1))
+    idx = np.arange(t)[None, None, :]
+    return jnp.asarray((off + idx) % period, jnp.int32)
+
+
+def _net(n_in=4, n_out=3):
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(n_in).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=n_out)
+            .pretrain(False).build())
+    return MultiLayerNetwork(conf)
+
+
+# ------------------------------------------------------------- KV cache
+class TestKVCache:
+    def test_cached_generate_matches_naive(self):
+        """The acceptance-criteria parity: trained model, cached vs
+        naive decode identical tokens (and the same output buffer)."""
+        p = _params()
+        p, _ = fit_scan(p, _cyclic_tokens(4, 8, 32), CFG, lr=0.1,
+                        epochs=30)
+        prompt = _cyclic_tokens(1, 2, 10, seed=3)[0]
+        naive = np.asarray(generate(p, prompt, CFG, 12))
+        cached = np.asarray(generate(p, prompt, CFG, 12, cache=True))
+        np.testing.assert_array_equal(naive, cached)
+
+    def test_prefill_logits_match_full_forward(self):
+        """Prefill's last-position logits == transformer_logits to 1e-5
+        (flash prefix path vs the reference forward)."""
+        from deeplearning4j_tpu.models.transformer import transformer_logits
+
+        p = _params()
+        tok = _cyclic_tokens(1, 3, 12)[0]
+        logits, cache = prefill(p, tok, init_cache(CFG, 3), CFG)
+        ref = transformer_logits(p, tok, CFG)[:, -1, :]
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                                   atol=1e-5)
+        assert int(cache.cursor) == 12
+
+    def test_decode_steps_match_incremental_full_forward(self):
+        """Teacher-forced decode over known tokens: each step's logits
+        must match the full forward at that position to 1e-5 — the O(1)
+        step is numerically the O(T) recompute."""
+        from deeplearning4j_tpu.models.transformer import transformer_logits
+
+        p = _params()
+        tok = _cyclic_tokens(1, 2, 16)[0]
+        t0 = 8
+        _, cache = prefill(p, tok[:, :t0], init_cache(CFG, 2), CFG)
+        for t in range(t0, 16):
+            logits, cache = decode_step(p, tok[:, t], cache, CFG)
+            ref = transformer_logits(p, tok[:, :t + 1], CFG)[:, -1, :]
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(ref), atol=1e-5)
+        assert int(cache.cursor) == 16
+
+    def test_loss_parity_anchor(self):
+        """Sanity anchor that the parity tests exercise a real model:
+        the trained lm_loss is finite and small-ish."""
+        p = _params()
+        batches = _cyclic_tokens(2, 4, 16)
+        assert np.isfinite(float(lm_loss(p, batches[0], CFG)))
+
+    def test_cache_memory_envelope(self):
+        # 2 (K,V) * n_layers * B * max_len * d_model * 4 bytes (f32)
+        assert kv_cache_bytes(CFG, 2) == 2 * 2 * 2 * 64 * 32 * 4
+
+    def test_cache_rejects_overlong_generation(self):
+        p = _params()
+        prompt = _cyclic_tokens(1, 1, 60)[0]
+        with pytest.raises(ValueError, match="max_len"):
+            generate(p, prompt, CFG, 8, cache=True)
+        with pytest.raises(ValueError, match="n_tokens"):
+            generate(p, prompt, CFG, 0, cache=True)
+
+
+# --------------------------------------------------------------- engine
+class TestInferenceEngine:
+    def test_ragged_stream_compiles_one_program_per_bucket(self):
+        """The acceptance-criteria pin: many distinct request sizes,
+        <= one program per bucket hit."""
+        net = _net()
+        engine = InferenceEngine.for_network(net, max_batch_size=32)
+        rng = np.random.RandomState(0)
+        sizes = [1, 3, 5, 7, 8, 9, 12, 17, 20, 25, 31, 32, 2, 11, 30]
+        hit_buckets = set()
+        for n in sizes:
+            x = rng.rand(n, 4).astype(np.float32)
+            out = engine.infer(x)
+            assert out.shape == (n, 3)
+            hit_buckets.add(
+                min(b for b in engine.buckets if b >= n))
+        programs = engine.program_cache_size()
+        assert programs >= 0, "jax _cache_size API drifted"
+        assert programs == len(hit_buckets) <= len(engine.buckets)
+
+    def test_matches_unbatched_output(self):
+        """Engine output rows == net.output for the same rows (padding
+        is inert row-wise)."""
+        net = _net()
+        engine = InferenceEngine.for_network(net, max_batch_size=32)
+        x = np.random.RandomState(1).rand(5, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            engine.infer(x), np.asarray(net.output(x, bucketed=False)),
+            atol=1e-6)
+
+    def test_warmup_precompiles_all_buckets(self):
+        net = _net()
+        engine = InferenceEngine.for_network(net, max_batch_size=16)
+        engine.warmup((4,))
+        before = engine.program_cache_size()
+        assert before == len(engine.buckets)
+        for n in (1, 5, 9, 16):
+            engine.infer(np.zeros((n, 4), np.float32))
+        assert engine.program_cache_size() == before  # zero recompiles
+
+    def test_oversize_request_takes_escape_bucket(self):
+        net = _net()
+        engine = InferenceEngine.for_network(net, max_batch_size=8)
+        out = engine.infer(np.zeros((20, 4), np.float32))
+        assert out.shape == (20, 3)
+
+    def test_stats_track_requests_and_latency(self):
+        net = _net()
+        engine = InferenceEngine.for_network(net, max_batch_size=8)
+        for n in (3, 8):
+            engine.infer(np.zeros((n, 4), np.float32))
+        snap = engine.snapshot()
+        assert snap["requests"] == 2 and snap["rows"] == 11
+        assert snap["padded_rows"] == 5  # 3 -> bucket 8
+        assert snap["latency_p99_ms"] >= snap["latency_p50_ms"] > 0
+
+    def test_rejects_bad_requests(self):
+        engine = InferenceEngine.for_network(_net())
+        with pytest.raises(ValueError, match="batch"):
+            engine.infer(np.zeros((4,), np.float32))
+        with pytest.raises(ValueError, match="empty"):
+            engine.infer(np.zeros((0, 4), np.float32))
+        with pytest.raises(ValueError, match="generate"):
+            engine.generate(np.zeros((1, 4), np.int32), 4)
+
+    def test_generate_guards_max_len_at_every_entry_point(self):
+        """The serving path (engine.generate -> generate_cached) must
+        reject overlong decodes itself — clamped cursors would silently
+        emit garbage, not crash."""
+        engine = InferenceEngine.for_transformer(_params(), CFG)
+        long_prompt = np.zeros((1, 60), np.int32)
+        with pytest.raises(ValueError, match="max_len"):
+            engine.generate(long_prompt, 8)
+        with pytest.raises(ValueError, match="n_tokens"):
+            engine.generate(np.zeros((1, 4), np.int32), 0)
+
+    def test_transformer_engine_generates(self):
+        p = _params()
+        engine = InferenceEngine.for_transformer(p, CFG)
+        prompt = np.asarray(_cyclic_tokens(1, 2, 6)[0])
+        out = engine.generate(prompt, 4)
+        assert out.shape == (2, 10)
+        ref = np.asarray(generate(p, jnp.asarray(prompt), CFG, 4,
+                                  cache=True))
+        np.testing.assert_array_equal(out, ref)
+
+
+# -------------------------------------------------------------- batcher
+class TestMicroBatcher:
+    def test_coalesces_and_preserves_order(self):
+        """Concurrent producers: every request's rows come back exactly
+        (identity engine), so coalescing never mixes or reorders rows."""
+        seen_batches = []
+
+        def run(x):
+            seen_batches.append(x.shape[0])
+            return x * 2.0
+
+        results = {}
+        with MicroBatcher(run, max_batch_size=64,
+                          max_delay_ms=20.0) as mb:
+            def producer(i):
+                x = np.full((i + 1, 3), float(i), np.float32)
+                results[i] = (x, mb.submit(x))
+
+            threads = [threading.Thread(target=producer, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for i, (x, fut) in results.items():
+                out = fut.result(timeout=10)
+                np.testing.assert_allclose(out, x * 2.0)
+        assert sum(seen_batches) == sum(i + 1 for i in range(8))
+        assert len(seen_batches) < 8  # actually coalesced
+        assert mb.snapshot()["completed"] == 8
+
+    def test_flushes_on_max_delay(self):
+        """A lone request must not wait for a full batch."""
+        with MicroBatcher(lambda x: x, max_batch_size=1024,
+                          max_delay_ms=10.0) as mb:
+            start = time.monotonic()
+            fut = mb.submit(np.ones((2, 2), np.float32))
+            fut.result(timeout=10)
+            assert time.monotonic() - start < 5.0
+
+    def test_oversize_request_is_held_not_split(self):
+        sizes = []
+        with MicroBatcher(lambda x: (sizes.append(x.shape[0]), x)[1],
+                          max_batch_size=8, max_delay_ms=50.0) as mb:
+            futs = [mb.submit(np.zeros((5, 2), np.float32))
+                    for _ in range(3)]
+            for f in futs:
+                assert f.result(timeout=10).shape == (5, 2)
+        assert all(s <= 8 for s in sizes)
+
+    def test_per_request_error_isolation(self):
+        """A bad-shape request fails alone; batch-mates still succeed."""
+        with MicroBatcher(lambda x: x + 1.0, max_batch_size=64,
+                          max_delay_ms=30.0) as mb:
+            good1 = mb.submit(np.zeros((2, 4), np.float32))
+            bad = mb.submit(np.zeros((2, 7), np.float32))  # width clash
+            good2 = mb.submit(np.zeros((3, 4), np.float32))
+            assert good1.result(timeout=10).shape == (2, 4)
+            assert good2.result(timeout=10).shape == (3, 4)
+            with pytest.raises(ValueError, match="feature shape"):
+                bad.result(timeout=10)
+        snap = mb.snapshot()
+        assert snap["completed"] == 2 and snap["failed"] == 1
+
+    def test_engine_failure_poisons_only_its_batch(self):
+        calls = {"n": 0}
+
+        def flaky(x):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("boom")
+            return x
+
+        with MicroBatcher(flaky, max_batch_size=4,
+                          max_delay_ms=1.0) as mb:
+            f1 = mb.submit(np.zeros((4, 2), np.float32))  # full -> flush
+            with pytest.raises(RuntimeError, match="boom"):
+                f1.result(timeout=10)
+            # the worker survived: next batch succeeds
+            f2 = mb.submit(np.zeros((4, 2), np.float32))
+            assert f2.result(timeout=10).shape == (4, 2)
+
+    def test_close_flushes_and_rejects_new_submits(self):
+        mb = MicroBatcher(lambda x: x, max_batch_size=64,
+                          max_delay_ms=5.0)
+        fut = mb.submit(np.ones((1, 2), np.float32))
+        mb.close()
+        assert fut.result(timeout=10).shape == (1, 2)
+        with pytest.raises(RuntimeError, match="closed"):
+            mb.submit(np.ones((1, 2), np.float32)).result()
+
+    def test_cancelled_future_does_not_kill_worker(self):
+        """A client giving up (cancel after a result timeout) must not
+        take down the worker thread for everyone else."""
+        gate = threading.Event()
+
+        def slow(x):
+            gate.wait(5)
+            return x
+
+        with MicroBatcher(slow, max_batch_size=1,
+                          max_delay_ms=1.0) as mb:
+            f1 = mb.submit(np.zeros((1, 2), np.float32))
+            time.sleep(0.05)  # worker is inside slow() with f1's batch
+            f2 = mb.submit(np.zeros((1, 2), np.float32))
+            assert f2.cancel()  # still pending -> cancellable
+            gate.set()
+            assert f1.result(timeout=10).shape == (1, 2)
+            # worker survived resolving the cancelled f2: still serving
+            f3 = mb.submit(np.zeros((1, 2), np.float32))
+            assert f3.result(timeout=10).shape == (1, 2)
+
+    def test_single_row_request_shapes(self):
+        with MicroBatcher(lambda x: x, max_delay_ms=1.0) as mb:
+            out = mb.submit(np.ones((3,), np.float32)).result(timeout=10)
+            assert out.shape == (1, 3)
+
+
+# ------------------------------------------------------------- replicas
+class TestReplicaSet:
+    def test_round_robin_spreads_traffic(self):
+        net = _net()
+        n_dev = min(4, len(jax.devices()))
+        reps = ReplicaSet.for_network(net, n_replicas=n_dev,
+                                      max_batch_size=8)
+        x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+        ref = reps.infer(x)
+        for _ in range(2 * n_dev - 1):
+            np.testing.assert_allclose(reps.infer(x), ref, atol=1e-6)
+        snap = reps.snapshot()
+        assert snap["replicas"] == n_dev
+        assert all(r["requests"] == 2 for r in snap["per_replica"])
+
+    def test_batcher_over_replicas(self):
+        net = _net()
+        reps = ReplicaSet.for_network(net, n_replicas=2, max_batch_size=16)
+        with reps.batcher(max_batch_size=16, max_delay_ms=5.0) as mb:
+            futs = [mb.submit(np.zeros((2, 4), np.float32))
+                    for _ in range(6)]
+            for f in futs:
+                assert f.result(timeout=30).shape == (2, 3)
+
+    def test_validates_inputs(self):
+        with pytest.raises(ValueError, match="at least one"):
+            ReplicaSet([])
+        with pytest.raises(ValueError, match="n_replicas"):
+            ReplicaSet.for_network(_net(), n_replicas=0)

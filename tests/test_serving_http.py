@@ -1,0 +1,130 @@
+"""End-to-end HTTP serving: /predict, /generate, /healthz, /stats on an
+ephemeral port; graceful shutdown releases the socket (the shared
+utils/httpd.py lifecycle both this server and plot/render_server use);
+CLI `serve` smoke."""
+
+from __future__ import annotations
+
+import json
+import socket
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   init_transformer_params)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import InferenceEngine, serve_network
+
+CFG = TransformerConfig(vocab_size=17, d_model=32, n_heads=2, n_layers=2,
+                        d_ff=64, max_len=64, interpret=True)
+
+
+def _net(n_in=4, n_out=3):
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(n_in).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=n_out)
+            .pretrain(False).build())
+    return MultiLayerNetwork(conf)
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class TestHTTPRoundTrip:
+    def test_predict_healthz_stats_and_shutdown(self):
+        net = _net()
+        handle = serve_network(net, n_replicas=2, max_batch_size=16,
+                               max_delay_ms=1.0, warmup_shape=(4,))
+        try:
+            assert handle.port != 0  # ephemeral port was bound
+            health = _get(f"{handle.url}/healthz")
+            assert health["ok"] and health["replicas"] == 2
+
+            x = np.random.RandomState(0).rand(3, 4)
+            out = _post(f"{handle.url}/predict",
+                        {"inputs": x.tolist()})
+            assert np.asarray(out["outputs"]).shape == (3, 3)
+            assert len(out["classes"]) == 3
+            ref = np.asarray(net.output(x.astype(np.float32)))
+            np.testing.assert_allclose(np.asarray(out["outputs"]), ref,
+                                       atol=1e-5)
+
+            stats = _get(f"{handle.url}/stats")
+            assert stats["replicas"]["rows"] >= 3
+            assert stats["batcher"]["completed"] >= 1
+            assert stats["uptime_s"] >= 0
+        finally:
+            handle.close()
+        # socket actually released: reconnect must fail fast
+        with pytest.raises((ConnectionError, urllib.error.URLError, OSError)):
+            _get(f"{handle.url}/healthz", timeout=2)
+        # and the port is rebindable (server_close ran)
+        with socket.socket() as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", handle.port))
+
+    def test_generate_endpoint(self):
+        params = init_transformer_params(jax.random.PRNGKey(0), CFG)
+        gen = InferenceEngine.for_transformer(params, CFG)
+        with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           generate_engine=gen) as handle:
+            prompt = [[1, 2, 3, 4]]
+            out = _post(f"{handle.url}/generate",
+                        {"prompt": prompt, "n_tokens": 5})
+            toks = np.asarray(out["tokens"])
+            assert toks.shape == (1, 9)
+            assert (toks[:, :4] == np.asarray(prompt)).all()
+            assert ((0 <= toks) & (toks < CFG.vocab_size)).all()
+
+    def test_error_paths(self):
+        with serve_network(_net(), n_replicas=1,
+                           max_delay_ms=1.0) as handle:
+            # bad JSON -> 400
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(f"{handle.url}/predict", {"nope": 1})
+            assert e.value.code == 400
+            # feature-width mismatch surfaces as a request error
+            with pytest.raises(urllib.error.HTTPError):
+                _post(f"{handle.url}/predict",
+                      {"inputs": [[1.0, 2.0]]})
+            # /generate without a transformer engine -> 404
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(f"{handle.url}/generate",
+                      {"prompt": [[1]], "n_tokens": 2})
+            assert e.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(f"{handle.url}/nowhere")
+            assert e.value.code == 404
+
+
+class TestCLIServe:
+    def test_serve_smoke(self, tmp_path, capsys):
+        from deeplearning4j_tpu.cli import main
+        from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
+
+        ckpt = str(tmp_path / "m.ckpt")
+        DefaultModelSaver(ckpt).save(_net())
+        assert main(["serve", "-m", ckpt, "--replicas", "1",
+                     "--max-delay-ms", "1", "--smoke"]) == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["serving"].startswith("http://127.0.0.1:")
+        assert out["replicas"] == 1

@@ -83,6 +83,10 @@ class TestTraining:
         out = np.asarray(generate(p, prompt, CFG, n_tokens=8))
         expect = (np.asarray(prompt[:, :1]) + np.arange(18)[None, :]) % 5
         np.testing.assert_array_equal(out, expect)
+        # the KV-cache serving path emits the same continuation
+        cached = np.asarray(generate(p, prompt, CFG, n_tokens=8,
+                                     cache=True))
+        np.testing.assert_array_equal(cached, expect)
 
 
 class TestDataParallel:
