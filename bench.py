@@ -534,6 +534,68 @@ def bench_serve():
             "window_s": round(win_s, 3)}
 
 
+def bench_telemetry():
+    """Telemetry overhead config (docs/OBSERVABILITY.md): the same
+    ragged iterator-driven fit as `feed` — the per-step dispatch loop is
+    where the registry's counter incs / histogram observes / disabled
+    spans land — run bare (registry kill switch off) vs instrumented
+    (default). The delta is the whole telemetry cost of a train step;
+    target <2% on the CPU smoke (asserted with a generous bound in
+    tests/test_telemetry.py). Also reports registry scale and the
+    /metrics render time, since scrapes run concurrently with serving.
+    """
+    import math
+
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.datasets import DeviceFeed, ListDataSetIterator
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.datasets.mnist import synthetic_mnist
+    from deeplearning4j_tpu.telemetry.exposition import render_prometheus
+
+    net, batch_size = _mlp_net()
+    n_batches = 4 if _fast() else 16
+    n = batch_size * n_batches + batch_size // 3  # ragged last batch
+    x_np, y_np = synthetic_mnist(n)
+    feed = DeviceFeed(ListDataSetIterator(DataSet(x_np, y_np), batch_size),
+                      prefetch=2)
+    epochs = 1 if _fast() else 4
+    steps = epochs * math.ceil(n / batch_size)
+
+    net.fit(feed, epochs=1)  # compile every bucket program
+    _d2h(net.params())
+
+    def window_instrumented():
+        net.fit(feed, epochs=epochs)
+        _d2h(net.params())
+
+    def window_bare():
+        telemetry.set_enabled(False)
+        try:
+            net.fit(feed, epochs=epochs)
+            _d2h(net.params())
+        finally:
+            telemetry.set_enabled(True)
+
+    rate_off, _ = _median_rate(window_bare, steps)
+    rate_on, win_s = _median_rate(window_instrumented, steps)
+    ms_on, ms_off = 1000.0 / rate_on, 1000.0 / rate_off
+    overhead_pct = (ms_on - ms_off) / ms_off * 100.0
+
+    t0 = time.perf_counter()
+    text = render_prometheus()
+    render_ms = (time.perf_counter() - t0) * 1e3
+    n_series = sum(1 for ln in text.splitlines()
+                   if ln and not ln.startswith("#"))
+    return {"value": round(ms_on, 4), "unit": "ms/instrumented_step",
+            "lower_is_better": True,
+            "bare_ms": round(ms_off, 4),
+            "overhead_pct": round(overhead_pct, 2),
+            "registry": {"series": n_series,
+                         "render_ms": round(render_ms, 3),
+                         "bytes": len(text)},
+            "steps_per_window": steps, "window_s": round(win_s, 3)}
+
+
 def _flash_inputs():
     import jax
     import jax.numpy as jnp
@@ -635,6 +697,7 @@ CONFIGS = {
     "feed": bench_feed,
     "guardian": bench_guardian,
     "serve": bench_serve,
+    "telemetry": bench_telemetry,
     "lenet": bench_lenet,
     "dbn": bench_dbn,
     "word2vec": bench_word2vec,
@@ -648,6 +711,7 @@ METRIC_NAMES = {
     "feed": "device_feed_ragged_stream_steps_per_sec",
     "guardian": "guardian_guarded_step_time_ms",
     "serve": "serving_decode_tokens_per_sec_cached",
+    "telemetry": "telemetry_instrumented_step_time_ms",
     "lenet": "lenet_mnist_step_time_ms",
     "dbn": "dbn_pretrain_finetune_samples_per_sec_per_chip",
     "word2vec": "word2vec_skipgram_pairs_per_sec",

@@ -13,6 +13,12 @@ Usage:
     python -m deeplearning4j_tpu.cli predict -i data.csv -m model.ckpt -o preds.csv
     python -m deeplearning4j_tpu.cli serve   -m model.ckpt --port 8000
 
+Telemetry (docs/OBSERVABILITY.md): `serve` answers GET /metrics on its
+own port; `--metrics-port N` (train and serve) additionally starts a
+standalone Prometheus endpoint (0 = auto-assign, printed), and
+`--trace PATH` records host spans and writes a Chrome-trace JSON on
+exit.
+
 Input CSV: one row per example, features then (for train/test) one-hot or
 integer label in the last column(s) — controlled by --label-columns.
 """
@@ -66,19 +72,66 @@ def _model_n_out(net) -> Optional[int]:
         return None
 
 
+class _Telemetry:
+    """Shared --metrics-port / --trace plumbing for the entrypoints:
+    optional standalone /metrics endpoint for the run's lifetime, and a
+    Chrome-trace dump on exit."""
+
+    def __init__(self, args):
+        self.metrics = None
+        self.trace_path = getattr(args, "trace", None)
+        port = getattr(args, "metrics_port", None)
+        if port is not None:
+            from deeplearning4j_tpu.telemetry.exposition import \
+                start_metrics_server
+
+            self.metrics = start_metrics_server(port=port)
+        if self.trace_path:
+            from deeplearning4j_tpu.telemetry import start_tracing
+
+            start_tracing()
+
+    def announce(self) -> dict:
+        return ({"metrics": self.metrics.url + "/metrics"}
+                if self.metrics is not None else {})
+
+    def close(self) -> dict:
+        out = {}
+        if self.trace_path:
+            from deeplearning4j_tpu.telemetry import save_chrome_trace
+
+            if save_chrome_trace(self.trace_path):
+                out["trace"] = self.trace_path
+        if self.metrics is not None:
+            self.metrics.close()
+        return out
+
+
 def cmd_train(args) -> int:
     from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
 
-    net = _load_model(args.model)
-    x, y = _load_csv(args.input, args.label_columns, _model_n_out(net))
-    if y is None:
-        print("train requires labels (--label-columns >= 1)",
-              file=sys.stderr)
-        return 2
-    net.fit(x, y, epochs=args.epochs)
-    DefaultModelSaver(args.output).save(net)
-    print(json.dumps({"saved": args.output,
-                      "score": float(net.score(x, y))}))
+    tele = _Telemetry(args)
+    if tele.metrics is not None:
+        # announce BEFORE the fit: the auto-assigned port is useless if
+        # it first appears after the endpoint is already shut down
+        print(json.dumps(tele.announce()), flush=True)
+    try:
+        net = _load_model(args.model)
+        x, y = _load_csv(args.input, args.label_columns, _model_n_out(net))
+        if y is None:
+            print("train requires labels (--label-columns >= 1)",
+                  file=sys.stderr)
+            return 2
+        net.fit(x, y, epochs=args.epochs)
+        DefaultModelSaver(args.output).save(net)
+        score = float(net.score(x, y))
+    finally:
+        # a failing fit (divergence abort, preemption) is exactly the
+        # run whose trace is wanted: flush it on the way out too
+        closed = tele.close()
+    # announce() is NOT repeated here: the metrics endpoint is already
+    # closed, and a dead URL in the summary line would mislead parsers
+    print(json.dumps({"saved": args.output, "score": score, **closed}))
     return 0
 
 
@@ -122,18 +175,27 @@ def cmd_predict(args) -> int:
 def cmd_serve(args) -> int:
     from deeplearning4j_tpu.serving.server import serve_network
 
-    net = _load_model(args.model)
-    n_in = net.conf.confs[0].n_in
-    handle = serve_network(
-        net, host=args.host, port=args.port, n_replicas=args.replicas,
-        max_batch_size=args.max_batch_size, max_delay_ms=args.max_delay_ms,
-        warmup_shape=(n_in,) if (args.warmup and n_in) else None)
+    tele = _Telemetry(args)
+    try:
+        net = _load_model(args.model)
+        n_in = net.conf.confs[0].n_in
+        handle = serve_network(
+            net, host=args.host, port=args.port, n_replicas=args.replicas,
+            max_batch_size=args.max_batch_size,
+            max_delay_ms=args.max_delay_ms,
+            warmup_shape=(n_in,) if (args.warmup and n_in) else None)
+    except BaseException:
+        tele.close()
+        raise
     print(json.dumps({"serving": handle.url,
                       "replicas": len(handle.replicas.engines),
                       "max_batch_size": args.max_batch_size,
-                      "max_delay_ms": args.max_delay_ms}), flush=True)
+                      "max_delay_ms": args.max_delay_ms,
+                      "metrics": handle.url + "/metrics",
+                      **tele.announce()}), flush=True)
     if args.smoke:  # start/stop sanity check (tests, deploy probes)
         handle.close()
+        tele.close()
         return 0
     try:
         handle.http.thread.join()
@@ -141,6 +203,7 @@ def cmd_serve(args) -> int:
         pass
     finally:
         handle.close()
+        tele.close()
     return 0
 
 
@@ -160,9 +223,18 @@ def build_parser() -> argparse.ArgumentParser:
             p.add_argument("--output", "-o", required=output_required,
                            help="output path")
 
+    def telemetry_flags(p):
+        p.add_argument("--metrics-port", type=int, default=None,
+                       help="start a standalone Prometheus /metrics "
+                            "endpoint on this port (0 = auto-assign)")
+        p.add_argument("--trace", default=None, metavar="PATH",
+                       help="record host spans; write Chrome-trace JSON "
+                            "here on exit (docs/OBSERVABILITY.md)")
+
     p_train = sub.add_parser("train", help="fit a model and checkpoint it")
     common(p_train, True)
     p_train.add_argument("--epochs", type=int, default=1)
+    telemetry_flags(p_train)
     p_train.set_defaults(fn=cmd_train)
 
     p_test = sub.add_parser("test", help="evaluate a model")
@@ -191,6 +263,7 @@ def build_parser() -> argparse.ArgumentParser:
                          help="skip precompiling the bucket programs")
     p_serve.add_argument("--smoke", action="store_true",
                          help="start, print the address, shut down")
+    telemetry_flags(p_serve)
     p_serve.set_defaults(fn=cmd_serve)
     return parser
 

@@ -35,8 +35,23 @@ from typing import Any, Iterator, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu import telemetry
+
 __all__ = ["FeedBatch", "DeviceFeed", "feed_mask", "pow2_buckets",
            "bucket_for", "pad_rows"]
+
+# feed pipeline telemetry (docs/OBSERVABILITY.md): process-wide twins of
+# the per-feed stats() counters, so bucket behavior and prefetch health
+# show up in /metrics without holding a DeviceFeed reference
+_M_BATCHES = telemetry.counter(
+    "dl4j_feed_batches", "batches staged through DeviceFeed")
+_M_PADDED = telemetry.counter(
+    "dl4j_feed_padded_examples", "bucketing padding rows shipped")
+_M_BUCKET = telemetry.counter(
+    "dl4j_feed_bucket_hits", "batches landing in each bucket size")
+_M_QUEUE = telemetry.gauge(
+    "dl4j_feed_prefetch_depth", "device_put transfers in flight ahead "
+    "of the train step (last observed window size)")
 
 
 def feed_mask(n_rows: int, n_valid):
@@ -182,6 +197,9 @@ class DeviceFeed:
         self.bucket_hits[b] += 1
         self.padded_examples += b - n
         self.batches += 1
+        _M_BATCHES.inc()
+        _M_PADDED.inc(b - n)
+        _M_BUCKET.labels(bucket=str(b)).inc()
         if b != n:
             # host materialization only when padding is actually needed:
             # a full-bucket batch from a device-resident source passes
@@ -242,9 +260,11 @@ class DeviceFeed:
             if len(window) < depth:
                 continue
             self.cursor += 1
+            _M_QUEUE.set(len(window) - 1)
             yield window.popleft()
         while window:
             self.cursor += 1
+            _M_QUEUE.set(len(window) - 1)
             yield window.popleft()
 
     # --------------------------------------------------- iterator surface

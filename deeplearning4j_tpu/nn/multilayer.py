@@ -17,6 +17,7 @@ computeDeltasR) are replaced by jax.grad / jax.jvp on the same loss.
 from __future__ import annotations
 
 import logging
+import time
 from functools import partial
 from typing import Dict, List, Optional, Sequence
 
@@ -35,10 +36,30 @@ from deeplearning4j_tpu.optimize.guardian import (GuardianAbort,
                                                   guarded_update, make_guard)
 from deeplearning4j_tpu.optimize.solver import Solver
 from deeplearning4j_tpu.optimize.updater import NetworkGradientUpdater
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.telemetry.trace import span
 from deeplearning4j_tpu.utils.jitcache import jit_cache_size
 from deeplearning4j_tpu.utils.sanitize import validate_batch
 
 log = logging.getLogger(__name__)
+
+# telemetry (docs/OBSERVABILITY.md): host-side counters only — nothing
+# here syncs a device value, so the training math is bit-identical with
+# telemetry on or off. Loss is gauged only where a float(score) host
+# sync already exists (listener dispatch / fit_scan's return).
+_M_STEPS = telemetry.counter(
+    "dl4j_train_steps", "supervised train steps dispatched")
+_M_EXAMPLES = telemetry.counter(
+    "dl4j_train_examples", "example rows dispatched (incl. bucket padding)")
+_M_EPOCHS = telemetry.counter("dl4j_train_epochs", "training epochs run")
+_M_STEP_S = telemetry.histogram(
+    "dl4j_train_step_seconds",
+    "wall time per train step; source=fit is per-step dispatch wall "
+    "time, source=scan is the per-step average of a compiled epoch, "
+    "source=parallel is the DP/ZeRO-1/TP trainer dispatch loop, "
+    "source=listener is StepTimeListener's listener-to-listener time")
+_M_LOSS = telemetry.gauge(
+    "dl4j_train_loss", "last host-synced training score")
 
 
 class MultiLayerNetwork:
@@ -65,6 +86,11 @@ class MultiLayerNetwork:
         self.listeners: List = []
         self._key = jax.random.PRNGKey(conf.confs[0].seed if conf.confs else 0)
         self.init()
+        # recompile counters surface as dl4j_jit_programs{cache=...}
+        # (weak-ref'd: watching never extends this network's lifetime)
+        from deeplearning4j_tpu.telemetry import device as _tdev
+        _tdev.watch_jit_cache("train_step", self.train_step_cache_size)
+        _tdev.watch_jit_cache("predict_step", self.predict_step_cache_size)
 
     # ------------------------------------------------------------- set-up
     def _infer_layer_sizes(self) -> None:
@@ -281,6 +307,7 @@ class MultiLayerNetwork:
             if self.conf.pretrain and self.has_pretrain_layers():
                 self.pretrain(raw)  # host-driven per-layer: unguarded
             for _ in range(epochs):
+                _M_EPOCHS.inc()
                 if guard is not None:
                     guard.begin_epoch()
                 if feed is not None:
@@ -305,6 +332,7 @@ class MultiLayerNetwork:
         if self.conf.pretrain and self.has_pretrain_layers():
             self.pretrain(x)
         for _ in range(epochs):
+            _M_EPOCHS.inc()
             if guard is not None:
                 guard.begin_epoch()
             self._fit_supervised(x, labels, guard=guard)
@@ -410,10 +438,21 @@ class MultiLayerNetwork:
         if guard is None:
             args = ((xb, yb, counts, int(epochs)) if masked
                     else (xb, yb, int(epochs)))
-            self._params, self._updater_state, score = self._scan_steps[key](
-                self._params, self._updater_state, *args, self.next_key())
-            self._iteration_count += epochs * n_batches
-            score = float(score)
+            t0 = time.perf_counter()
+            with span("fit_scan", epochs=int(epochs), batches=n_batches):
+                (self._params, self._updater_state,
+                 score) = self._scan_steps[key](
+                    self._params, self._updater_state, *args,
+                    self.next_key())
+                self._iteration_count += epochs * n_batches
+                score = float(score)  # the one host sync of this path
+            steps = epochs * n_batches
+            _M_STEP_S.labels(source="scan").observe(
+                (time.perf_counter() - t0) / max(1, steps))
+            _M_STEPS.inc(steps)
+            _M_EXAMPLES.inc(epochs * n)
+            _M_EPOCHS.inc(epochs)
+            _M_LOSS.set(score)
             for listener in self.listeners:
                 listener.iteration_done(self, self._iteration_count - 1,
                                         score)
@@ -426,13 +465,17 @@ class MultiLayerNetwork:
                 guard.arm_once((self._params, self._updater_state))
             args = ((xb, yb, counts, 1) if masked else (xb, yb, 1))
             score = None
+            scan_child = _M_STEP_S.labels(source="scan")
             for _ in range(epochs):
                 guard.begin_epoch()
+                t0 = time.perf_counter()
                 if guarded:
-                    (self._params, self._updater_state, gstate,
-                     score) = self._scan_steps[key](
-                        self._params, self._updater_state, guard.gstate,
-                        *args, self.next_key())
+                    with span("fit_scan_epoch", guarded=True,
+                              batches=n_batches):
+                        (self._params, self._updater_state, gstate,
+                         score) = self._scan_steps[key](
+                            self._params, self._updater_state, guard.gstate,
+                            *args, self.next_key())
                     self._iteration_count += n_batches
                     try:
                         # steps=n_batches: the ladder's cadences stay in
@@ -445,13 +488,20 @@ class MultiLayerNetwork:
                         raise
                     self._params, self._updater_state = live
                 else:
-                    (self._params, self._updater_state,
-                     score) = self._scan_steps[key](
-                        self._params, self._updater_state, *args,
-                        self.next_key())
+                    with span("fit_scan_epoch", batches=n_batches):
+                        (self._params, self._updater_state,
+                         score) = self._scan_steps[key](
+                            self._params, self._updater_state, *args,
+                            self.next_key())
                     self._iteration_count += n_batches
+                scan_child.observe(
+                    (time.perf_counter() - t0) / max(1, n_batches))
+                _M_STEPS.inc(n_batches)
+                _M_EXAMPLES.inc(n)
+                _M_EPOCHS.inc()
                 guard.tick()
             score = float(score)
+            _M_LOSS.set(score)
             for listener in self.listeners:
                 listener.iteration_done(self, self._iteration_count - 1,
                                         score)
@@ -533,12 +583,15 @@ class MultiLayerNetwork:
             if guarded:
                 guard.arm_once((self._params, self._updater_state))
             score = None
+            step_child = _M_STEP_S.labels(source="fit")
             for i in range(conf0.num_iterations):
+                t0 = time.perf_counter()
                 if guarded:
-                    (self._params, self._updater_state, gstate,
-                     score) = step(self._params, self._updater_state,
-                                   guard.gstate, x, labels, self.next_key(),
-                                   n_valid)
+                    with span("train_step", guarded=True):
+                        (self._params, self._updater_state, gstate,
+                         score) = step(self._params, self._updater_state,
+                                       guard.gstate, x, labels,
+                                       self.next_key(), n_valid)
                     self._iteration_count += 1
                     try:
                         live, _ = guard.post_step(
@@ -551,13 +604,20 @@ class MultiLayerNetwork:
                         raise
                     self._params, self._updater_state = live
                 else:
-                    self._params, self._updater_state, score = step(
-                        self._params, self._updater_state, x, labels,
-                        self.next_key(), n_valid)
+                    with span("train_step"):
+                        self._params, self._updater_state, score = step(
+                            self._params, self._updater_state, x, labels,
+                            self.next_key(), n_valid)
                     self._iteration_count += 1
-            for listener in self.listeners:
-                listener.iteration_done(self, self._iteration_count - 1,
-                                        float(score))
+                step_child.observe(time.perf_counter() - t0)
+                _M_STEPS.inc()
+                _M_EXAMPLES.inc(x.shape[0])
+            if self.listeners:  # float() only where it always was:
+                score_f = float(score)  # no-listener fits stay sync-free
+                _M_LOSS.set(score_f)
+                for listener in self.listeners:
+                    listener.iteration_done(self, self._iteration_count - 1,
+                                            score_f)
         else:
             if guarded:
                 raise ValueError(

@@ -61,6 +61,7 @@ from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.optimize.terminations import DivergenceCondition
 
 # NOTE: scaleout.checkpoint is imported lazily (TrainingGuard.__init__) —
@@ -68,6 +69,14 @@ from deeplearning4j_tpu.optimize.terminations import DivergenceCondition
 # level import here would be circular.
 
 log = logging.getLogger(__name__)
+
+# every guardian event flows through TrainingGuard._emit, so one counter
+# covers skips/rollbacks/aborts/autosaves/preemptions; the known kinds
+# are pre-seeded at 0 so a scrape sees the series before the first fault
+_M_EVENTS = telemetry.counter(
+    "dl4j_guardian_events", "guardian escalation/autosave events by kind")
+for _kind in ("skip", "rollback", "abort", "autosave", "preempt"):
+    _M_EVENTS.labels(kind=_kind)
 
 __all__ = [
     "GuardianState", "guardian_state", "all_finite", "commit", "advance",
@@ -498,6 +507,7 @@ class TrainingGuard:
     def _emit(self, kind: str, step: int, info: Optional[dict] = None
               ) -> None:
         event = GuardianEvent(kind, step, dict(info or {}))
+        _M_EVENTS.labels(kind=kind).inc()
         level = (logging.WARNING if kind in ("rollback", "abort", "preempt")
                  else logging.INFO)
         log.log(level, "guardian %s at step %d: %s", kind, step, event.info)

@@ -9,6 +9,13 @@ SLF4J score logging; the TPU equivalents are `StepTimeListener` (wall-clock
 step-time metrics with summary stats) and `ProfilerListener` (toggles a
 jax.profiler trace for a window of iterations so steps can be inspected in
 xprof/TensorBoard).
+
+Telemetry: the listeners keep their public API but also publish into the
+process-global registry (deeplearning4j_tpu/telemetry) — scores land on
+the `dl4j_train_loss` gauge and StepTimeListener's deltas in the
+`dl4j_train_step_seconds{source="listener"}` histogram — so anything a
+listener records shows up in a /metrics scrape without a second code
+path (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -18,7 +25,15 @@ import statistics
 import time
 from typing import Iterable, Optional
 
+from deeplearning4j_tpu import telemetry
+
 log = logging.getLogger(__name__)
+
+_M_LOSS = telemetry.gauge(
+    "dl4j_train_loss", "last host-synced training score")
+_M_STEP_S = telemetry.histogram("dl4j_train_step_seconds")
+_M_ITER = telemetry.counter(
+    "dl4j_listener_iterations", "iteration_done listener dispatches")
 
 
 class IterationListener:
@@ -31,6 +46,8 @@ class ScoreIterationListener(IterationListener):
         self.print_every = max(1, print_every)
 
     def iteration_done(self, model, iteration: int, score: float) -> None:
+        _M_ITER.inc()
+        _M_LOSS.set(score)
         if iteration % self.print_every == 0:
             log.info("Score at iteration %d is %s", iteration, score)
 
@@ -51,7 +68,10 @@ class CollectScoresListener(IterationListener):
         self.scores = []
 
     def iteration_done(self, model, iteration: int, score: float) -> None:
-        self.scores.append((iteration, float(score)))
+        score = float(score)
+        _M_ITER.inc()
+        _M_LOSS.set(score)
+        self.scores.append((iteration, score))
 
 
 class StepTimeListener(IterationListener):
@@ -74,6 +94,7 @@ class StepTimeListener(IterationListener):
         if self._last is not None:
             dt = now - self._last
             self.step_times.append(dt)
+            _M_STEP_S.labels(source="listener").observe(dt)
             if self.log_every and len(self.step_times) % self.log_every == 0:
                 log.info("step %d: %.3f ms", iteration, dt * 1e3)
         self._last = now

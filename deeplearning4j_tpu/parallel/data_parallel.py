@@ -19,13 +19,16 @@ SURVEY §2.8) with two TPU-native modes:
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.datasets.device_feed import DeviceFeed, feed_mask
+from deeplearning4j_tpu.telemetry.trace import span
 from deeplearning4j_tpu.optimize.guardian import (GuardianAbort,
                                                   guarded_update, make_guard)
 from deeplearning4j_tpu.optimize.updater import NetworkGradientUpdater
@@ -35,6 +38,19 @@ from deeplearning4j_tpu.parallel.mesh import (
     make_mesh,
     replicated,
 )
+
+# the trainers share the nn.multilayer step/example counters (same
+# metric names, get-or-create) but tag their step-time source so a DP
+# dispatch loop is distinguishable from the single-chip fit loop
+_M_STEPS = telemetry.counter(
+    "dl4j_train_steps", "supervised train steps dispatched")
+_M_EXAMPLES = telemetry.counter(
+    "dl4j_train_examples", "example rows dispatched (incl. bucket padding)")
+_M_EPOCHS = telemetry.counter("dl4j_train_epochs", "training epochs run")
+_M_LOSS = telemetry.gauge(
+    "dl4j_train_loss", "last host-synced training score")
+# same family nn.multilayer registers (get-or-create by name)
+_M_STEP_S = telemetry.histogram("dl4j_train_step_seconds")
 
 
 class DataParallelTrainer:
@@ -203,15 +219,20 @@ class DataParallelTrainer:
             with ctx, self.mesh:
                 if guarded:
                     guard.arm_once((params, upd_state))
+                step_child = _M_STEP_S.labels(source="parallel")
                 for _ in range(epochs):
+                    _M_EPOCHS.inc()
                     if guard is not None:
                         guard.begin_epoch()
                     for x, labels, n_valid in self._epoch_batches(iterator,
                                                                   feed):
+                        t0 = time.perf_counter()
                         if guarded:
-                            params, upd_state, gstate, score = self._gstep(
-                                params, upd_state, guard.gstate, x, labels,
-                                net.next_key(), n_valid)
+                            with span("parallel_train_step", guarded=True):
+                                params, upd_state, gstate, score = \
+                                    self._gstep(
+                                        params, upd_state, guard.gstate, x,
+                                        labels, net.next_key(), n_valid)
                             try:
                                 ((params, upd_state),
                                  _) = guard.post_step((params, upd_state),
@@ -220,9 +241,13 @@ class DataParallelTrainer:
                                 params, upd_state = e.last_good
                                 raise
                         else:
-                            params, upd_state, score = self._step(
-                                params, upd_state, x, labels, net.next_key(),
-                                n_valid)
+                            with span("parallel_train_step"):
+                                params, upd_state, score = self._step(
+                                    params, upd_state, x, labels,
+                                    net.next_key(), n_valid)
+                        step_child.observe(time.perf_counter() - t0)
+                        _M_STEPS.inc()
+                        _M_EXAMPLES.inc(x.shape[0])
                         steps += 1
                         if guard is not None:
                             # keep the net's view current so autosave /
@@ -235,6 +260,8 @@ class DataParallelTrainer:
             # always point at the live outputs, even on an interrupted fit
             net._params = params
             net._updater_state = upd_state
-        if steps:
+        if steps and net.listeners:  # float() only where it always was:
+            score_f = float(score)   # no-listener fits stay sync-free
+            _M_LOSS.set(score_f)
             for listener in net.listeners:
-                listener.iteration_done(net, steps - 1, float(score))
+                listener.iteration_done(net, steps - 1, score_f)

@@ -24,6 +24,7 @@ global norm and is rejected.
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Optional
 
 import jax
@@ -34,10 +35,19 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.optimize.guardian import (GuardianAbort, advance,
                                                   all_finite, make_guard)
+from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.optimize.updater import ADAGRAD_EPS
 from deeplearning4j_tpu.datasets.device_feed import feed_mask
 from deeplearning4j_tpu.parallel.data_parallel import DataParallelTrainer
 from deeplearning4j_tpu.parallel.mesh import batch_sharding, replicated
+from deeplearning4j_tpu.telemetry.trace import span
+
+# same families the other trainers publish into (get-or-create by name)
+_M_STEPS = telemetry.counter("dl4j_train_steps")
+_M_EXAMPLES = telemetry.counter("dl4j_train_examples")
+_M_EPOCHS = telemetry.counter("dl4j_train_epochs")
+_M_LOSS = telemetry.gauge("dl4j_train_loss")
+_M_STEP_S = telemetry.histogram("dl4j_train_step_seconds")
 
 __all__ = ["ShardedUpdateTrainer"]
 
@@ -257,16 +267,21 @@ class ShardedUpdateTrainer(DataParallelTrainer):
             with ctx, self.mesh:
                 if guarded:
                     guard.arm_once((params, hist, vel, it))
+                step_child = _M_STEP_S.labels(source="parallel")
                 for _ in range(epochs):
+                    _M_EPOCHS.inc()
                     if guard is not None:
                         guard.begin_epoch()
                     for x, labels, n_valid in self._epoch_batches(iterator,
                                                                   feed):
+                        t0 = time.perf_counter()
                         if guarded:
-                            (params, hist, vel, it, gstate,
-                             score) = self._gstep(params, hist, vel, it,
-                                                  guard.gstate, x, labels,
-                                                  net.next_key(), n_valid)
+                            with span("parallel_train_step", guarded=True):
+                                (params, hist, vel, it, gstate,
+                                 score) = self._gstep(params, hist, vel, it,
+                                                      guard.gstate, x,
+                                                      labels, net.next_key(),
+                                                      n_valid)
                             try:
                                 ((params, hist, vel, it),
                                  _) = guard.post_step((params, hist, vel, it),
@@ -275,9 +290,13 @@ class ShardedUpdateTrainer(DataParallelTrainer):
                                 params, hist, vel, it = e.last_good
                                 raise
                         else:
-                            params, hist, vel, it, score = self._step(
-                                params, hist, vel, it, x, labels,
-                                net.next_key(), n_valid)
+                            with span("parallel_train_step"):
+                                params, hist, vel, it, score = self._step(
+                                    params, hist, vel, it, x, labels,
+                                    net.next_key(), n_valid)
+                        step_child.observe(time.perf_counter() - t0)
+                        _M_STEPS.inc()
+                        _M_EXAMPLES.inc(x.shape[0])
                         steps += 1
                         if guard is not None:
                             net._params = params
@@ -286,9 +305,11 @@ class ShardedUpdateTrainer(DataParallelTrainer):
         finally:
             net._params = params
             self._flat_state = (hist, vel, it)
-        if steps:
+        if steps and net.listeners:  # float() only where it always was
+            score_f = float(score)
+            _M_LOSS.set(score_f)
             for listener in net.listeners:
-                listener.iteration_done(net, steps - 1, float(score))
+                listener.iteration_done(net, steps - 1, score_f)
 
     def restore_flat_state(self, metadata: dict) -> None:
         """Reinstall the flat optimizer state an autosaved checkpoint

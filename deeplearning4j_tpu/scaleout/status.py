@@ -7,11 +7,19 @@ sibling server (nlp/plot/dropwizard/RenderApplication.java:37 — our
 plot/render_server.py covers that one).
 
 TPU-native design: a tiny stdlib ThreadingHTTPServer owned by the master
-process (the tracker is pure control plane, SURVEY §2.8), serving
+process (the tracker is pure control plane, SURVEY §2.8) on the shared
+utils/httpd.py `ServerHandle` lifecycle (graceful shutdown releases the
+listening socket — serving/server.py and plot/render_server.py migrated
+in PR 3; this server now rides the same helper), serving
 
 - ``GET /status.json`` — machine-readable snapshot: workers with
   heartbeat ages, in-flight jobs, pending updates, counters, KV keys,
-  wave progress (when attached to a runtime), early-stop state;
+  wave progress (when attached to a runtime), early-stop state, plus
+  server uptime + package version;
+- ``GET /healthz`` — liveness: ok / uptime_s / version;
+- ``GET /metrics`` — Prometheus text exposition of the process-global
+  telemetry registry (``/snapshot`` is the JSON twin) — the same
+  catalogue the serving front end exposes, docs/OBSERVABILITY.md;
 - ``GET /`` — a self-contained HTML view that polls the JSON.
 
 The server never blocks training: every read takes the tracker's lock
@@ -25,6 +33,9 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional
+
+from deeplearning4j_tpu.telemetry import exposition
+from deeplearning4j_tpu.utils.httpd import ServerHandle
 
 _PAGE = """<!doctype html>
 <html><head><title>deeplearning4j-tpu run status</title>
@@ -77,10 +88,14 @@ def _jsonable(value: Any) -> Any:
 
 
 def snapshot(tracker, runtime=None,
-             extra: Optional[Callable[[], Dict[str, Any]]] = None
-             ) -> Dict[str, Any]:
+             extra: Optional[Callable[[], Dict[str, Any]]] = None,
+             started_at: Optional[float] = None) -> Dict[str, Any]:
     """One coherent status snapshot of a tracker (and optionally the
-    master runtime driving it)."""
+    master runtime driving it). `started_at` (the owning server's start
+    time) adds uptime; the package version always rides along so a
+    fleet scrape can tell which build each master runs."""
+    from deeplearning4j_tpu import __version__
+
     now = time.time()
     heartbeats = tracker.heartbeats()
     state: Dict[str, Any] = {
@@ -100,6 +115,11 @@ def snapshot(tracker, runtime=None,
         },
         "batch_size": tracker.batch_size(),
         "done": tracker.is_done(),
+        "server": {
+            "version": __version__,
+            **({"uptime_s": round(now - started_at, 3)}
+               if started_at is not None else {}),
+        },
     }
     stale = tracker.stale_workers(now)
     if stale:
@@ -118,7 +138,10 @@ def snapshot(tracker, runtime=None,
 
 class StatusServer:
     """Serve `snapshot` over HTTP from a daemon thread (the Dropwizard
-    status-UI equivalent, BaseHazelCastStateTracker.java:181-189)."""
+    status-UI equivalent, BaseHazelCastStateTracker.java:181-189), on
+    the shared utils/httpd.py ServerHandle lifecycle. The socket binds
+    at construction (so `address` is valid before `start()`); the serve
+    thread runs between start() and stop()."""
 
     def __init__(self, tracker, runtime=None, host: str = "127.0.0.1",
                  port: int = 0,
@@ -126,6 +149,7 @@ class StatusServer:
         self.tracker = tracker
         self.runtime = runtime
         self.extra = extra
+        self.started_at = time.time()
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -133,11 +157,35 @@ class StatusServer:
                 if self.path in ("/status.json", "/status"):
                     try:
                         body = json.dumps(snapshot(
-                            outer.tracker, outer.runtime,
-                            outer.extra)).encode()
+                            outer.tracker, outer.runtime, outer.extra,
+                            started_at=outer.started_at)).encode()
                         ctype = "application/json"
                         code = 200
                     except Exception as e:  # surface, don't kill the thread
+                        body = json.dumps({"error": repr(e)}).encode()
+                        ctype = "application/json"
+                        code = 500
+                elif self.path.startswith(("/healthz", "/metrics",
+                                           "/snapshot")):
+                    # same surface-don't-kill contract as /status.json:
+                    # a rendering error must answer 500, not reset the
+                    # scraper's connection
+                    try:
+                        if self.path.startswith("/healthz"):
+                            from deeplearning4j_tpu import __version__
+
+                            body = json.dumps({
+                                "ok": True,
+                                "uptime_s": round(
+                                    time.time() - outer.started_at, 3),
+                                "version": __version__,
+                            }).encode()
+                            ctype = "application/json"
+                        else:
+                            _, ctype, body = exposition.handle_metrics_get(
+                                self.path)
+                        code = 200
+                    except Exception as e:
                         body = json.dumps({"error": repr(e)}).encode()
                         ctype = "application/json"
                         code = 500
@@ -158,20 +206,22 @@ class StatusServer:
             def log_message(self, *args):  # quiet
                 pass
 
-        self._server = ThreadingHTTPServer((host, port), _Handler)
-        self.host, self.port = self._server.server_address[:2]
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name="status-server",
-            daemon=True)
+        server = ThreadingHTTPServer((host, port), _Handler)
+        thread = threading.Thread(
+            target=server.serve_forever, name="status-server", daemon=True)
+        self.handle = ServerHandle(server, thread)
+        self.host, self.port = self.handle.host, self.handle.port
 
     @property
     def address(self) -> str:
         return f"http://{self.host}:{self.port}"
 
     def start(self) -> "StatusServer":
-        self._thread.start()
+        self.started_at = time.time()
+        self.handle.thread.start()
         return self
 
     def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
+        """Graceful: stop serving, release the socket, join the serve
+        thread (ServerHandle.close)."""
+        self.handle.close()

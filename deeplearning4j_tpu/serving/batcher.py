@@ -26,6 +26,7 @@ Contract:
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -34,9 +35,12 @@ from typing import Callable, NamedTuple, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu import telemetry
+
 __all__ = ["MicroBatcher"]
 
 _CLOSE = object()
+_batcher_seq = itertools.count()
 
 
 class _Request(NamedTuple):
@@ -74,15 +78,55 @@ class MicroBatcher:
         self._q: "queue.Queue" = queue.Queue()
         self._closed = False
         self._lock = threading.Lock()
-        # counters (worker-thread writes, snapshot reads under lock)
-        self.submitted = 0
-        self.completed = 0
-        self.failed = 0
-        self.batches = 0
-        self.batched_rows = 0
+        # counters live in the telemetry registry (labeled per batcher)
+        # so /metrics and snapshot() read the same series — no parallel
+        # stat mechanism (docs/OBSERVABILITY.md)
+        reg = telemetry.get_registry()
+        self.label = f"{name}-{next(_batcher_seq)}"
+        lab = {"batcher": self.label}
+        self._m_submitted = reg.counter(
+            "dl4j_batcher_submitted", "requests submitted").labels(**lab)
+        self._m_completed = reg.counter(
+            "dl4j_batcher_completed", "requests completed").labels(**lab)
+        self._m_failed = reg.counter(
+            "dl4j_batcher_failed", "requests failed").labels(**lab)
+        self._m_batches = reg.counter(
+            "dl4j_batcher_batches", "coalesced engine forwards").labels(**lab)
+        self._m_rows = reg.counter(
+            "dl4j_batcher_rows", "rows shipped in coalesced batches"
+        ).labels(**lab)
+        self._m_queue = reg.gauge(
+            "dl4j_batcher_queue_depth",
+            "requests waiting in the coalescing queue").labels(**lab)
+        # weak: the registry outlives every batcher; a dead batcher's
+        # queue gauge must read 0, not pin the queue in memory
+        import weakref
+        qsize = weakref.WeakMethod(self._q.qsize)
+        self._m_queue.set_function(lambda: (qsize() or (lambda: 0))())
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name=name)
         self._worker.start()
+
+    # registry-backed counter views (historical attribute surface)
+    @property
+    def submitted(self) -> int:
+        return int(self._m_submitted.value)
+
+    @property
+    def completed(self) -> int:
+        return int(self._m_completed.value)
+
+    @property
+    def failed(self) -> int:
+        return int(self._m_failed.value)
+
+    @property
+    def batches(self) -> int:
+        return int(self._m_batches.value)
+
+    @property
+    def batched_rows(self) -> int:
+        return int(self._m_rows.value)
 
     # ----------------------------------------------------------- submit
     def submit(self, x) -> Future:
@@ -102,7 +146,7 @@ class MicroBatcher:
             if self._closed:
                 fut.set_exception(RuntimeError("batcher is closed"))
                 return fut
-            self.submitted += 1
+            self._m_submitted.inc()
             # enqueue under the lock: close() also takes it before
             # putting the sentinel, so no request can land AFTER _CLOSE
             # and strand its future in a dead queue
@@ -142,8 +186,7 @@ class MicroBatcher:
                 _resolve(req.future, exc=ValueError(
                     f"request feature shape {req.x.shape[1:]} does not "
                     f"match batch feature shape {tail}"))
-                with self._lock:
-                    self.failed += 1
+                self._m_failed.inc()
                 continue
             good.append(req)
             offsets.append(rows)
@@ -158,13 +201,11 @@ class MicroBatcher:
             # batch-level failure: poison only THIS batch's futures
             for req in good:
                 _resolve(req.future, exc=e)
-            with self._lock:
-                self.failed += len(good)
+            self._m_failed.inc(len(good))
             return
-        with self._lock:
-            self.batches += 1
-            self.batched_rows += rows
-            self.completed += len(good)
+        self._m_batches.inc()
+        self._m_rows.inc(rows)
+        self._m_completed.inc(len(good))
         for req, off in zip(good, offsets):
             _resolve(req.future, out[off:off + req.x.shape[0]])
 
@@ -202,17 +243,16 @@ class MicroBatcher:
 
     # ------------------------------------------------------------ stats
     def snapshot(self) -> dict:
-        with self._lock:
-            per_batch = (self.batched_rows / self.batches
-                         if self.batches else 0.0)
-            return {
-                "submitted": self.submitted,
-                "completed": self.completed,
-                "failed": self.failed,
-                "batches": self.batches,
-                "mean_rows_per_batch": round(per_batch, 2),
-                "occupancy": round(per_batch / self.max_batch_size, 4),
-                "queue_depth": self._q.qsize(),
-                "max_batch_size": self.max_batch_size,
-                "max_delay_ms": self.max_delay_s * 1000.0,
-            }
+        batches, rows = self.batches, self.batched_rows
+        per_batch = (rows / batches) if batches else 0.0
+        return {
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "failed": self.failed,
+            "batches": batches,
+            "mean_rows_per_batch": round(per_batch, 2),
+            "occupancy": round(per_batch / self.max_batch_size, 4),
+            "queue_depth": self._q.qsize(),
+            "max_batch_size": self.max_batch_size,
+            "max_delay_ms": self.max_delay_s * 1000.0,
+        }

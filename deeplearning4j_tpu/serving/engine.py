@@ -22,64 +22,117 @@ per bucket" in tests and bench.
 
 from __future__ import annotations
 
-import threading
+import itertools
 import time
-from collections import deque
 from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.datasets.device_feed import (DEFAULT_MIN_BUCKET,
                                                      bucket_for,
                                                      pow2_buckets)
+from deeplearning4j_tpu.telemetry.trace import span
 from deeplearning4j_tpu.utils.jitcache import jit_cache_size
 
 __all__ = ["EngineStats", "InferenceEngine"]
 
-
-def _percentile(sorted_vals, q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
-    return sorted_vals[idx]
+_engine_seq = itertools.count()
 
 
 class EngineStats:
-    """Thread-safe per-engine counters + a bounded latency reservoir."""
+    """Per-engine serving stats as a VIEW over the telemetry registry.
 
-    def __init__(self, window: int = 2048):
-        self._lock = threading.Lock()
-        self.requests = 0
-        self.rows = 0
-        self.padded_rows = 0
-        self.errors = 0
-        self._latencies = deque(maxlen=window)
+    Historically this class kept its own lock-and-dict counters in
+    parallel with everything else's; now each engine owns a labeled set
+    of registry series (`dl4j_serve_*{engine=...}`) and this object is
+    just the typed accessor — the same numbers appear in `/metrics`, in
+    `/stats`, and here, with no second code path. Latency percentiles
+    come from the histogram's bounded reservoir; each timed window ends
+    with the D2H read of the result (the honest protocol from
+    BASELINE.md). Note `telemetry.set_enabled(False)` blanks recording
+    here too — the registry IS the storage.
+    """
+
+    def __init__(self, window: int = 2048, label: Optional[str] = None,
+                 registry=None):
+        reg = registry if registry is not None else telemetry.get_registry()
+        self.label = label if label is not None else f"e{next(_engine_seq)}"
+        lab = {"engine": self.label}
+        self._requests = reg.counter(
+            "dl4j_serve_requests", "inference requests served").labels(**lab)
+        self._rows = reg.counter(
+            "dl4j_serve_rows", "real request rows served").labels(**lab)
+        self._padded = reg.counter(
+            "dl4j_serve_padded_rows",
+            "bucket-padding rows shipped alongside real rows").labels(**lab)
+        self._errors = reg.counter(
+            "dl4j_serve_errors", "failed inference requests").labels(**lab)
+        self._latency = reg.histogram(
+            "dl4j_serve_latency_seconds",
+            "per-request wall latency incl. the result D2H read",
+            window=window).labels(**lab)
+        self._bucket_fam = reg.counter(
+            "dl4j_serve_bucket_forwards",
+            "compiled-bucket forwards by bucket size")
+        # memoized per-bucket children: labels() takes the family lock
+        # shared across ALL engines — not a per-request cost
+        self._bucket_children: dict = {}
+
+    # typed accessors (the historical attribute surface)
+    @property
+    def requests(self) -> int:
+        return int(self._requests.value)
+
+    @property
+    def rows(self) -> int:
+        return int(self._rows.value)
+
+    @property
+    def padded_rows(self) -> int:
+        return int(self._padded.value)
+
+    @property
+    def errors(self) -> int:
+        return int(self._errors.value)
 
     def record(self, rows: int, bucket: int, seconds: float) -> None:
-        with self._lock:
-            self.requests += 1
-            self.rows += rows
-            self.padded_rows += bucket - rows
-            self._latencies.append(seconds)
+        self._requests.inc()
+        self._rows.inc(rows)
+        self._padded.inc(bucket - rows)
+        self._latency.observe(seconds)
+        child = self._bucket_children.get(bucket)
+        if child is None:  # benign race: labels() is get-or-create
+            child = self._bucket_fam.labels(engine=self.label,
+                                            bucket=str(bucket))
+            self._bucket_children[bucket] = child
+        child.inc()
 
     def record_error(self) -> None:
-        with self._lock:
-            self.errors += 1
+        self._errors.inc()
+
+    def bucket_forwards(self) -> dict:
+        """{bucket_size: forward_count} for this engine."""
+        out = {}
+        for labels, child in self._bucket_fam.children():
+            if labels.get("engine") == self.label:
+                out[int(labels["bucket"])] = int(child.value)
+        return out
 
     def snapshot(self) -> dict:
-        with self._lock:
-            lat = sorted(self._latencies)
-            shipped = self.rows + self.padded_rows
-            return {
-                "requests": self.requests,
-                "rows": self.rows,
-                "padded_rows": self.padded_rows,
-                "errors": self.errors,
-                # fraction of shipped rows that were real work
-                "occupancy": (self.rows / shipped) if shipped else 0.0,
-                "latency_p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
-                "latency_p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
-            }
+        rows, padded = self.rows, self.padded_rows
+        shipped = rows + padded
+        return {
+            "requests": self.requests,
+            "rows": rows,
+            "padded_rows": padded,
+            "errors": self.errors,
+            # fraction of shipped rows that were real work
+            "occupancy": (rows / shipped) if shipped else 0.0,
+            "latency_p50_ms": round(self._latency.percentile(0.50) * 1e3, 3),
+            "latency_p99_ms": round(self._latency.percentile(0.99) * 1e3, 3),
+            "bucket_forwards": self.bucket_forwards(),
+        }
 
 
 class InferenceEngine:
@@ -115,6 +168,8 @@ class InferenceEngine:
         self._jit = jax.jit(apply_fn, donate_argnums=donate)
         self._generate_fn = generate_fn
         self.stats = EngineStats()
+        from deeplearning4j_tpu.telemetry import device as _tdev
+        _tdev.watch_jit_cache("serving_engine", self.program_cache_size)
 
     # ----------------------------------------------------- constructors
     @classmethod
@@ -165,12 +220,13 @@ class InferenceEngine:
             raise ValueError("empty request")
         start = time.perf_counter()
         try:
-            b = bucket_for(n, self.buckets)
-            if b != n:  # pad on host — the H2D copy ships once
-                x = np.concatenate(
-                    [x, np.zeros((b - n, *x.shape[1:]), x.dtype)])
-            xb = jax.device_put(x, self.device)
-            out = np.asarray(self._jit(self._params, xb)[:n])
+            with span("engine_infer", rows=n):
+                b = bucket_for(n, self.buckets)
+                if b != n:  # pad on host — the H2D copy ships once
+                    x = np.concatenate(
+                        [x, np.zeros((b - n, *x.shape[1:]), x.dtype)])
+                xb = jax.device_put(x, self.device)
+                out = np.asarray(self._jit(self._params, xb)[:n])
         except Exception:
             self.stats.record_error()
             raise

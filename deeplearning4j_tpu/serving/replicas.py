@@ -75,11 +75,18 @@ class ReplicaSet:
 
     def snapshot(self) -> dict:
         reps = [e.snapshot() for e in self.engines]
+        buckets: dict = {}
+        for r in reps:
+            for b, c in r.get("bucket_forwards", {}).items():
+                buckets[b] = buckets.get(b, 0) + c
         return {
             "replicas": len(self.engines),
             "requests": sum(r["requests"] for r in reps),
             "rows": sum(r["rows"] for r in reps),
             "errors": sum(r["errors"] for r in reps),
             "compiled_programs": self.program_cache_size(),
+            # aggregated per-bucket forward counts across replicas
+            "bucket_forwards": {str(b): buckets[b]
+                                for b in sorted(buckets)},
             "per_replica": reps,
         }

@@ -12,7 +12,11 @@ thread, port-0 auto-assign, graceful close. Endpoints:
   {"tokens": [[...]]} — KV-cached decode (requires a transformer
   engine; 404 otherwise).
 - ``GET /healthz``   liveness + replica count.
-- ``GET /stats``     replica + batcher + uptime counters.
+- ``GET /stats``     replica + batcher (queue depth, per-bucket forward
+  counts) + uptime counters.
+- ``GET /metrics``   Prometheus text exposition of the process-global
+  telemetry registry (train/serve/guardian/device series —
+  docs/OBSERVABILITY.md); ``GET /snapshot`` is the JSON twin.
 
 This front end is deliberately minimal (stdlib only, JSON in/out, one
 process): production fronting (TLS, auth, load shedding) belongs in the
@@ -32,6 +36,7 @@ import numpy as np
 
 from deeplearning4j_tpu.serving.engine import InferenceEngine
 from deeplearning4j_tpu.serving.replicas import ReplicaSet
+from deeplearning4j_tpu.telemetry import exposition
 from deeplearning4j_tpu.utils.httpd import ServerHandle, start_http_server
 
 __all__ = ["ServingHandle", "serve_network"]
@@ -121,9 +126,12 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
             pass
 
         def _reply(self, code: int, payload: dict) -> None:
-            body = json.dumps(payload).encode()
+            self._reply_raw(code, "application/json",
+                            json.dumps(payload).encode())
+
+        def _reply_raw(self, code: int, ctype: str, body: bytes) -> None:
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -145,6 +153,9 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                                       "replicas": len(replicas.engines)})
                 elif self.path.startswith("/stats"):
                     self._reply(200, handle.stats())
+                elif (hit := exposition.handle_metrics_get(
+                        self.path)) is not None:
+                    self._reply_raw(*hit)
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
             except Exception as e:  # always answer with a status line

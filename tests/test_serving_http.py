@@ -71,9 +71,42 @@ class TestHTTPRoundTrip:
             stats = _get(f"{handle.url}/stats")
             assert stats["replicas"]["rows"] >= 3
             assert stats["batcher"]["completed"] >= 1
+            assert stats["batcher"]["queue_depth"] >= 0
+            # per-bucket forward counts (3 rows -> the 8-bucket)
+            assert sum(stats["replicas"]["bucket_forwards"].values()) >= 1
             assert stats["uptime_s"] >= 0
         finally:
             handle.close()
+
+    def test_metrics_e2e_scrape(self):
+        """Acceptance bar: a /metrics scrape on a live serve instance
+        returns Prometheus text carrying train/serve/guardian/device
+        series (docs/OBSERVABILITY.md)."""
+        net = _net()
+        with serve_network(net, n_replicas=1, max_batch_size=16,
+                           max_delay_ms=1.0) as handle:
+            x = np.random.RandomState(0).rand(2, 4)
+            _post(f"{handle.url}/predict", {"inputs": x.tolist()})
+            with urllib.request.urlopen(f"{handle.url}/metrics",
+                                        timeout=30) as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                text = r.read().decode()
+            for series in (
+                    "dl4j_serve_requests_total",      # serve
+                    "dl4j_serve_latency_seconds_bucket",
+                    "dl4j_serve_bucket_forwards_total",
+                    "dl4j_batcher_queue_depth",
+                    "dl4j_train_steps_total",         # train
+                    "dl4j_guardian_events_total",     # guardian
+                    "dl4j_device_count",              # device
+                    "dl4j_device_memory_bytes",
+                    "dl4j_jit_programs",
+            ):
+                assert series in text, f"{series} missing from /metrics"
+            # this serve instance's engine actually counted the request
+            assert 'dl4j_serve_requests_total{engine="' in text
+            snap = _get(f"{handle.url}/snapshot")
+            assert "dl4j_serve_requests" in snap
         # socket actually released: reconnect must fail fast
         with pytest.raises((ConnectionError, urllib.error.URLError, OSError)):
             _get(f"{handle.url}/healthz", timeout=2)

@@ -76,6 +76,63 @@ class TestStatusServer:
         # KV is not exposed wholesale; but counters/arrays must be safe
         json.dumps(s)  # everything JSON-serializable
 
+    def test_uptime_and_version_in_snapshot(self):
+        from deeplearning4j_tpu import __version__
+
+        code, _, body = _get(self.server.address + "/status.json")
+        s = json.loads(body)
+        assert s["server"]["version"] == __version__
+        assert s["server"]["uptime_s"] >= 0
+
+    def test_healthz_route(self):
+        from deeplearning4j_tpu import __version__
+
+        code, ctype, body = _get(self.server.address + "/healthz")
+        assert code == 200 and ctype.startswith("application/json")
+        hz = json.loads(body)
+        assert hz["ok"] and hz["version"] == __version__
+        assert hz["uptime_s"] >= 0
+
+    def test_metrics_route_serves_prometheus_text(self):
+        code, ctype, body = _get(self.server.address + "/metrics")
+        assert code == 200 and ctype.startswith("text/plain")
+        text = body.decode()
+        assert "dl4j_train_steps_total" in text
+        assert "dl4j_guardian_events_total" in text
+        assert "dl4j_device_count" in text
+
+    def test_metrics_route_failure_answers_500_not_reset(self, monkeypatch):
+        """A rendering error must produce a diagnosable 500 response —
+        the surface-don't-kill contract of /status.json — not a dropped
+        connection."""
+        from deeplearning4j_tpu.scaleout import status as status_mod
+
+        def boom(path, registry=None):
+            raise RuntimeError("render kaput")
+
+        monkeypatch.setattr(status_mod.exposition, "handle_metrics_get",
+                            boom)
+        try:
+            _get(self.server.address + "/metrics")
+            code, err = 200, ""
+        except urllib.error.HTTPError as e:
+            code, err = e.code, e.read().decode()
+        assert code == 500 and "render kaput" in err
+
+    def test_stop_releases_socket_and_joins(self):
+        """ServerHandle lifecycle: stop() must release the listening
+        socket (rebindable) and join the serve thread."""
+        import socket
+
+        tracker = InMemoryStateTracker()
+        server = StatusServer(tracker).start()
+        port = server.port
+        server.stop()
+        assert not server.handle.thread.is_alive()
+        with socket.socket() as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", port))
+
 
 class TestStatusDuringMultiProcessRun:
     def test_poll_status_during_live_run(self, tmp_path):
