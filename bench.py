@@ -534,6 +534,92 @@ def bench_serve():
             "window_s": round(win_s, 3)}
 
 
+def bench_checkpoint():
+    """Checkpoint subsystem config (docs/CHECKPOINTS.md): (a) the
+    per-autosave STEP-LOOP STALL — blocking single-file npz writer
+    (serialize+write on the caller) vs the async sharded writer (the
+    caller pays only the device→host snapshot; serialize+IO overlap
+    training) — the acceptance gate is async < 20% of blocking; (b)
+    committed save and restore bandwidth of the sharded format; (c)
+    resharded restore: the same checkpoint reassembled from its
+    per-device shards onto a single device (the 8→1 topology move)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.checkpoint import (ShardedModelSaver,
+                                               read_manifest,
+                                               restore_network)
+    from deeplearning4j_tpu.datasets.mnist import synthetic_mnist
+    from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
+
+    net, batch_size = _mlp_net()
+    # one tiny fit materializes updater state so checkpoints carry the
+    # full production payload (params + hist + velocity)
+    x_np, y_np = synthetic_mnist(batch_size)
+    net.fit_scan(jnp.asarray(x_np), jnp.asarray(y_np),
+                 batch_size=batch_size, epochs=1)
+    _d2h(net.params())
+
+    work = tempfile.mkdtemp(prefix="dl4j_bench_ckpt_")
+    repeats = 3 if _fast() else 5
+    try:
+        # ---- (a) stall: blocking npz vs async sharded snapshot
+        blocking = DefaultModelSaver(os.path.join(work, "block.ckpt"),
+                                     keep_old=False)
+        stalls_b = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            blocking.save(net)
+            stalls_b.append(time.perf_counter() - t0)
+        stall_blocking = statistics.median(stalls_b)
+
+        saver = ShardedModelSaver(os.path.join(work, "sharded"),
+                                  keep=2, max_in_flight=2)
+        saver.save(net, iterator_position=0)  # warm the worker/dirs
+        saver.flush()
+        stalls_a, commits = [], []
+        for i in range(repeats):
+            t0 = time.perf_counter()
+            saver.save(net, iterator_position=i + 1)
+            stalls_a.append(time.perf_counter() - t0)
+            saver.flush()  # outside the stall clock
+            commits.append(time.perf_counter() - t0)
+        stall_async = statistics.median(stalls_a)
+        commit_s = statistics.median(commits)
+        manifest = read_manifest(os.path.join(work, "sharded"))
+        mb = manifest.get("total_bytes", 0) / 1e6
+        saver.close()
+
+        # ---- (b) restore bandwidth + (c) 8→1 resharded restore: the
+        # shards were written per-device; restoring reassembles them and
+        # places the tree on ONE device
+        dev0 = jax.devices()[0]
+        t0 = time.perf_counter()
+        net2, _ = restore_network(os.path.join(work, "sharded"))
+        net2._params = jax.device_put(net2._params, dev0)
+        _d2h(net2.params())
+        restore_s = time.perf_counter() - t0
+
+        ratio = stall_async / stall_blocking if stall_blocking else None
+        return {
+            "value": round(stall_async * 1e3, 3), "unit": "ms/async_stall",
+            "lower_is_better": True,
+            "blocking_stall_ms": round(stall_blocking * 1e3, 3),
+            "stall_ratio": round(ratio, 4) if ratio is not None else None,
+            "stall_under_20pct": bool(ratio is not None and ratio < 0.20),
+            "checkpoint_mb": round(mb, 2),
+            "save_mb_s": round(mb / commit_s, 2) if commit_s else None,
+            "restore_mb_s": round(mb / restore_s, 2) if restore_s else None,
+            "reshard_restore_s": round(restore_s, 4),
+            "n_devices": len(jax.devices()),
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_telemetry():
     """Telemetry overhead config (docs/OBSERVABILITY.md): the same
     ragged iterator-driven fit as `feed` — the per-step dispatch loop is
@@ -697,6 +783,7 @@ CONFIGS = {
     "feed": bench_feed,
     "guardian": bench_guardian,
     "serve": bench_serve,
+    "checkpoint": bench_checkpoint,
     "telemetry": bench_telemetry,
     "lenet": bench_lenet,
     "dbn": bench_dbn,
@@ -711,6 +798,7 @@ METRIC_NAMES = {
     "feed": "device_feed_ragged_stream_steps_per_sec",
     "guardian": "guardian_guarded_step_time_ms",
     "serve": "serving_decode_tokens_per_sec_cached",
+    "checkpoint": "checkpoint_async_save_stall_ms",
     "telemetry": "telemetry_instrumented_step_time_ms",
     "lenet": "lenet_mnist_step_time_ms",
     "dbn": "dbn_pretrain_finetune_samples_per_sec_per_chip",

@@ -1,0 +1,122 @@
+"""Canonical ↔ strategy-specific optimizer-state conversion.
+
+Checkpoints store optimizer state in ONE canonical form — the per-layer
+``{layer: UpdaterState(hist, velocity, iteration)}`` pytree mirroring
+the parameter tree — regardless of which trainer produced it. The DP/TP
+trainers already carry exactly that; the ZeRO-1 trainer
+(parallel/sharded_update.py) carries FLAT replica-sharded vectors
+instead, so its saves convert flat→tree here and its restores convert
+tree→flat. Both directions are pure host reshapes (ravel/unravel over
+the same sorted-key flatten order `ravel_pytree` uses) — no arithmetic,
+so a ZeRO-1 checkpoint restores BIT-identically into a DP or TP or
+single-device run and back (the cross-strategy portability the issue's
+acceptance demands).
+
+The flatten order gotcha is inherited from ShardedUpdateTrainer:
+``ravel_pytree`` flattens string-keyed dicts in SORTED key order
+('0', '1', '10', '11', '2', ...), so these helpers walk layers in that
+same order — never numeric order — or slices land on the wrong layers
+at 11+ layers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+from deeplearning4j_tpu.optimize.updater import UpdaterState
+
+__all__ = ["layer_slices", "flat_to_updater_state", "updater_state_to_flat"]
+
+
+def layer_slices(params: Dict[str, dict]) -> Dict[str, Tuple[int, int]]:
+    """{layer_key: (offset, size)} of each layer's slice of the packed
+    vector, in ravel_pytree's sorted-key flatten order."""
+    out = {}
+    offset = 0
+    for key in sorted(params):
+        flat_i, _ = ravel_pytree(params[key])
+        out[key] = (offset, int(flat_i.size))
+        offset += int(flat_i.size)
+    return out
+
+
+def _np_unravel(like_tree, vec: np.ndarray):
+    """Unflatten `vec` into `like_tree`'s structure/shapes as NUMPY
+    leaves — same leaf order as ravel_pytree (tree_flatten order), but
+    without the device round-trip ravel_pytree's unravel closure pays
+    (it always produces jnp arrays)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(like_tree)
+    out, off = [], 0
+    for leaf in leaves:
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        out.append(np.asarray(vec[off:off + n]).reshape(leaf.shape))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def flat_to_updater_state(hist, velocity, iteration,
+                          params: Dict[str, dict]) -> Dict[str, dict]:
+    """ZeRO-1 flat vectors → canonical per-layer UpdaterState tree.
+
+    `hist`/`velocity` are the UNPADDED packed vectors (length ==
+    total param count; longer inputs are treated as device-count
+    padding and sliced off); `iteration` is the shared scalar — every
+    layer's UpdaterState gets it (the trainers advance all layers in
+    lockstep, so per-layer counters are identical by construction).
+
+    Leaves come back as HOST (numpy) arrays: this runs on the save path
+    (the trainers' autosave) where a device copy would be a wasted
+    H2D+D2H round trip — restore-side consumers (jitted trainers,
+    restore_network) convert on first use.
+    """
+    hist = np.asarray(hist)
+    velocity = np.asarray(velocity)
+    slices = layer_slices(params)
+    total = sum(size for _, size in slices.values())
+    if hist.size < total or velocity.size < total:
+        raise ValueError(
+            f"flat optimizer state has {min(hist.size, velocity.size)} "
+            f"elements but the network packs {total} parameters — "
+            "checkpoint does not match this architecture")
+    it = np.asarray(np.asarray(iteration), np.int32)
+    state = {}
+    for key, (off, size) in slices.items():
+        state[key] = UpdaterState(
+            hist=_np_unravel(params[key], hist[off:off + size]),
+            velocity=_np_unravel(params[key], velocity[off:off + size]),
+            iteration=it)
+    return state
+
+
+def updater_state_to_flat(state: Dict[str, dict], params: Dict[str, dict]
+                          ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Canonical per-layer UpdaterState tree → ZeRO-1 flat vectors
+    (UNPADDED — the trainer re-pads to its own mesh width). Returns
+    (hist, velocity, iteration) host arrays."""
+    hists, vels = [], []
+    iteration = None
+    for key in sorted(params):
+        if key not in state:
+            raise ValueError(
+                f"updater state has no entry for layer {key!r} — "
+                "checkpoint does not match this architecture")
+        st = state[key]
+        h, _ = ravel_pytree(st.hist)
+        v, _ = ravel_pytree(st.velocity)
+        p, _ = ravel_pytree(params[key])
+        if h.size != p.size or v.size != p.size:
+            raise ValueError(
+                f"layer {key!r}: updater state packs {int(h.size)} "
+                f"elements, params pack {int(p.size)} — mismatched "
+                "architecture")
+        hists.append(np.asarray(h, np.float32))
+        vels.append(np.asarray(v, np.float32))
+        if iteration is None:
+            iteration = np.asarray(st.iteration, np.int32)
+    return (np.concatenate(hists), np.concatenate(vels),
+            np.asarray(iteration, np.int32))

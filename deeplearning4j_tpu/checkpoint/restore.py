@@ -1,0 +1,148 @@
+"""Cross-topology checkpoint restore.
+
+A sharded checkpoint records GLOBAL logical arrays (as a shard table);
+restoring onto a different topology is therefore two moves:
+
+1. **Reassemble** — `format.load_tree` stitches each leaf's shards back
+   into its global host array (crc-verified, coverage-checked, errors
+   naming the leaf).
+2. **Re-slice** — place each global array under the TARGET sharding:
+   `jax.device_put(global, target_sharding)` lets the runtime slice and
+   distribute per the new (mesh, PartitionSpec), which is the whole
+   array-redistribution problem (arXiv:2112.01075) delegated to the
+   layer that already solves it. A restore into a jitted trainer doesn't
+   even need the explicit put — jit's `in_shardings` reshard committed
+   arrays on first dispatch.
+
+Strategy portability rides on the canonical state form (convert.py):
+params tree + per-layer UpdaterState + cursor, so DP ↔ ZeRO-1 ↔ TP and
+8 devices ↔ 1 device are all the same restore with a different target.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+from deeplearning4j_tpu.checkpoint import format as ckfmt
+
+__all__ = ["resolve_root", "load_payload_tree", "restore_network",
+           "restore_params_for", "validate_like"]
+
+
+def resolve_root(path: str) -> Tuple[str, Optional[int]]:
+    """Accept either a checkpoint ROOT (holding step_* dirs) or one
+    step directory; return (root, pinned_step_or_None)."""
+    if os.path.exists(os.path.join(path, ckfmt.MANIFEST)):
+        step = ckfmt.step_of(path)
+        if step is None:
+            raise ckfmt.CheckpointError(
+                f"{path} holds a manifest but is not named step_<n>")
+        return os.path.dirname(os.path.abspath(path)), step
+    return path, None
+
+
+def load_payload_tree(path: str, step: Optional[int] = None
+                      ) -> Tuple[Any, dict]:
+    """(payload, manifest) with every array leaf reassembled to its
+    global host array."""
+    root, pinned = resolve_root(path)
+    return ckfmt.load_tree(root, step if step is not None else pinned)
+
+
+def restore_network(path: str, step: Optional[int] = None):
+    """Rebuild a MultiLayerNetwork (+ canonical updater state + cursor)
+    from a sharded checkpoint. Returns (network, info) with the same
+    info contract as scaleout.checkpoint.load_checkpoint, plus 'step'
+    and 'mesh' (the SOURCE topology, informational)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    payload, manifest = load_payload_tree(path, step)
+    if payload.get("conf_json") is None:
+        raise ValueError(
+            f"Checkpoint {path} step {manifest['step']} has no conf_json "
+            "(params-only runtime checkpoint); rebuild the network from "
+            "its config and install payload['params'] directly")
+    net = MultiLayerNetwork.from_config_json(payload["conf_json"])
+    net._params = jax.tree_util.tree_map(jnp.asarray, payload["params"])
+    if payload.get("updater_state") is not None:
+        net._updater_state = jax.tree_util.tree_map(
+            jnp.asarray, payload["updater_state"])
+    net._iteration_count = payload.get("iteration_count", 0)
+    info = {
+        "iterator_position": payload.get("iterator_position"),
+        "metadata": payload.get("metadata", {}),
+        "saved_at": payload.get("saved_at"),
+        "step": manifest["step"],
+        "mesh": manifest.get("mesh"),
+    }
+    return net, info
+
+
+def restore_params_for(path: str, shardings, step: Optional[int] = None):
+    """Restore just the params tree, placed under `shardings` — a single
+    sharding applied to every leaf, or a pytree of shardings matching
+    the params tree (the TP trainer's `_param_specs` output, through
+    NamedSharding). This is the explicit resharding entry point; the
+    trainers' jitted `in_shardings` make it optional for training."""
+    import jax
+
+    payload, _ = load_payload_tree(path, step)
+    params = payload["params"]
+    if isinstance(shardings, jax.sharding.Sharding):
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, shardings), params)
+    return jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, s), params, shardings)
+
+
+def validate_like(restored, reference, *, context: str = "restore") -> None:
+    """Per-leaf dtype/shape validation with the LEAF PATH in the error —
+    the 'clear error naming the mismatched leaf' the issue demands,
+    instead of an opaque tree-structure or GSPMD shape failure later."""
+    import jax
+
+    ref_paths = {_path_str(p): leaf for p, leaf in
+                 jax.tree_util.tree_flatten_with_path(reference)[0]}
+    got_paths = {_path_str(p): leaf for p, leaf in
+                 jax.tree_util.tree_flatten_with_path(restored)[0]}
+    missing = sorted(set(ref_paths) - set(got_paths))
+    extra = sorted(set(got_paths) - set(ref_paths))
+    if missing or extra:
+        raise ValueError(
+            f"{context}: checkpoint tree does not match the target — "
+            f"missing leaves {missing[:4]}, unexpected leaves {extra[:4]}")
+    for path, ref in ref_paths.items():
+        got = got_paths[path]
+        ref_shape = tuple(getattr(ref, "shape", ()))
+        got_shape = tuple(getattr(got, "shape", ()))
+        if ref_shape != got_shape:
+            raise ValueError(
+                f"{context}: leaf {path!r} has shape {got_shape} in the "
+                f"checkpoint but the target expects {ref_shape}")
+        ref_dt = getattr(ref, "dtype", None)
+        got_dt = getattr(got, "dtype", None)
+        if ref_dt is not None and got_dt is not None and ref_dt != got_dt:
+            # same shapes but a different dtype would silently change
+            # serving numerics AND retrace every compiled bucket program
+            # on the live request path — refuse, naming the leaf
+            raise ValueError(
+                f"{context}: leaf {path!r} has dtype {got_dt} in the "
+                f"checkpoint but the target expects {ref_dt}")
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)

@@ -1,0 +1,276 @@
+"""Async checkpoint writer: snapshot on the caller, serialize+IO off it.
+
+The step loop's only synchronous cost is `snapshot_tree` — a device→host
+copy of the state (per-shard D2H reads for mesh-sharded arrays, so each
+device's slice ships once and lands in its own shard file). The copy is
+double-buffering by construction: once the numpy snapshot exists the
+live device buffers are free to keep updating (the fit loops donate them
+to the next step), while a single background worker serializes the
+snapshot to the sharded directory format and commits it.
+
+In-flight saves are BOUNDED (`max_in_flight`): when the queue is full,
+`save()` blocks until the worker drains a slot — backpressure, not
+unbounded host-memory growth, when checkpoint cadence outruns disk.
+Rotation (`keep`) garbage-collects old committed steps and any
+uncommitted crash leftovers after every commit.
+
+Telemetry (docs/OBSERVABILITY.md): `dl4j_ckpt_saves`,
+`dl4j_ckpt_bytes_written`, `dl4j_ckpt_snapshot_seconds` (the step-loop
+stall), `dl4j_ckpt_write_seconds` (worker-side serialize+IO),
+`dl4j_ckpt_in_flight` gauge, `dl4j_ckpt_last_committed_step` gauge.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.checkpoint import format as ckfmt
+from deeplearning4j_tpu.telemetry.trace import span
+
+__all__ = ["snapshot_tree", "mesh_spec_of", "AsyncCheckpointWriter"]
+
+_M_SAVES = telemetry.counter(
+    "dl4j_ckpt_saves", "sharded checkpoint saves committed")
+_M_BYTES = telemetry.counter(
+    "dl4j_ckpt_bytes_written", "checkpoint shard bytes written")
+_M_ERRORS = telemetry.counter(
+    "dl4j_ckpt_errors", "checkpoint saves that failed")
+_M_SNAP_S = telemetry.histogram(
+    "dl4j_ckpt_snapshot_seconds",
+    "device->host snapshot duration (the synchronous step-loop stall)")
+_M_WRITE_S = telemetry.histogram(
+    "dl4j_ckpt_write_seconds",
+    "background serialize+IO duration per checkpoint")
+_M_IN_FLIGHT = telemetry.gauge(
+    "dl4j_ckpt_in_flight", "checkpoint saves snapshot-taken but not yet "
+    "committed")
+_M_LAST_STEP = telemetry.gauge(
+    "dl4j_ckpt_last_committed_step", "newest committed checkpoint step")
+
+
+def _is_jax_array(obj) -> bool:
+    mod = type(obj).__module__ or ""
+    return mod.startswith(("jax", "jaxlib")) and hasattr(obj, "dtype")
+
+
+def _copy_to_host(x) -> np.ndarray:
+    # an OWNED copy, never a view: on CPU backends np.asarray(jax_array)
+    # can be zero-copy, and the fit loops DONATE the live buffers to the
+    # next step — a view would let the background writer read torn data
+    return np.array(x, copy=True)
+
+
+def _snapshot_leaf(arr) -> Any:
+    """One leaf device→host: a mesh-sharded jax.Array becomes a
+    HostLeaf with one HostShard per DISTINCT device slice (replicated
+    copies collapse to one); anything else copies whole."""
+    if not _is_jax_array(arr):
+        return np.asarray(arr) if isinstance(arr, np.generic) else arr
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards or not getattr(arr, "is_fully_addressable", True):
+        # multihost arrays: each process sees only its slice — gather is
+        # the caller's job (the ZeRO-1 save_fn does); here take the local
+        # view to stay crash-safe rather than deadlock on a collective
+        return _copy_to_host(arr)
+    seen = set()
+    host_shards = []
+    for s in shards:
+        index = tuple((sl.start, sl.stop) for sl in s.index)
+        if index in seen:
+            continue
+        seen.add(index)
+        host_shards.append(ckfmt.HostShard(index, _copy_to_host(s.data)))
+    if len(host_shards) == 1:
+        # fully replicated (or single-device): store the plain array
+        return host_shards[0].data
+    return ckfmt.HostLeaf(dtype=ckfmt._dtype_name(arr.dtype),
+                          shape=tuple(arr.shape), shards=host_shards)
+
+
+def snapshot_tree(payload):
+    """Device→host snapshot of a checkpoint payload pytree (dicts,
+    tuples, lists, NamedTuples, scalars pass through; array leaves
+    become np arrays or per-device HostLeafs)."""
+    if payload is None or isinstance(payload, (bool, int, float, str)):
+        return payload
+    if isinstance(payload, ckfmt.HostLeaf):
+        return payload
+    if isinstance(payload, (np.ndarray, np.generic)) \
+            or _is_jax_array(payload):
+        return _snapshot_leaf(payload)
+    if hasattr(payload, "_fields"):  # NamedTuple
+        return type(payload)(*(snapshot_tree(v) for v in payload))
+    if isinstance(payload, dict):
+        return {k: snapshot_tree(v) for k, v in payload.items()}
+    if isinstance(payload, tuple):
+        return tuple(snapshot_tree(v) for v in payload)
+    if isinstance(payload, list):
+        return [snapshot_tree(v) for v in payload]
+    return payload  # codec raises with the leaf path if unsupported
+
+
+def mesh_spec_of(mesh=None, strategy: Optional[str] = None
+                 ) -> Optional[dict]:
+    """JSON-able record of the SOURCE topology — informational: restore
+    never needs it (the shard table is self-describing), but `checkpoint
+    inspect` and debugging do."""
+    spec: Dict[str, Any] = {}
+    if mesh is not None:
+        spec["axes"] = {name: int(size)
+                        for name, size in zip(mesh.axis_names,
+                                              mesh.devices.shape)}
+        spec["n_devices"] = int(np.prod(mesh.devices.shape))
+    if strategy:
+        spec["strategy"] = strategy
+    return spec or None
+
+
+class AsyncCheckpointWriter:
+    """Background sharded-checkpoint writer for one checkpoint root.
+
+    `save()` = synchronous snapshot + bounded enqueue; a single daemon
+    worker serializes, commits (marker rename), rotates old steps, and
+    resolves the returned Future with the committed directory. A worker
+    failure is (a) set on that save's Future and (b) re-raised from the
+    NEXT save()/flush()/close() call so a fit loop cannot silently train
+    past a dead checkpoint stream.
+    """
+
+    def __init__(self, root: str, *, keep: int = 3, max_in_flight: int = 2,
+                 sync: bool = False):
+        if max_in_flight < 1:
+            raise ValueError(
+                f"max_in_flight must be >= 1, got {max_in_flight}")
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.root = root
+        self.keep = keep
+        self.sync = sync
+        os.makedirs(root, exist_ok=True)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max_in_flight)
+        self._error: Optional[BaseException] = None
+        self._error_lock = threading.Lock()
+        self._pending = 0  # snapshot taken, commit not yet resolved
+        self._cond = threading.Condition()
+        self._closed = False
+        self._auto_step = None  # next auto step when save(step=None)
+        #: test hook — called with each filename before it is written
+        #: (crash-mid-save drills raise from it)
+        self.between_files: Optional[Callable[[str], None]] = None
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="ckpt-writer")
+        self._worker.start()
+
+    # ------------------------------------------------------------------ api
+    def save(self, payload, *, step: Optional[int] = None,
+             mesh_spec: Optional[dict] = None,
+             wait: bool = False) -> str:
+        """Snapshot `payload` and schedule its write; returns the step
+        directory the checkpoint will commit to. Blocks only for the
+        snapshot (plus backpressure when `max_in_flight` saves are
+        already pending). `wait=True` (or a writer built with sync=True)
+        blocks until the commit is durable — the preemption-flush path,
+        where the process is about to die and an un-flushed Future is
+        worthless."""
+        self._reraise()
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointWriter is closed")
+        if step is None:
+            step = self._next_auto_step()
+        t0 = time.perf_counter()
+        with span("ckpt_snapshot", step=int(step)):
+            host = snapshot_tree(payload)
+        _M_SNAP_S.observe(time.perf_counter() - t0)
+        fut: Future = Future()
+        with self._cond:
+            self._pending += 1
+        _M_IN_FLIGHT.inc()
+        self._queue.put((int(step), host, mesh_spec, fut))
+        if wait or self.sync:
+            return fut.result()
+        return os.path.join(self.root, ckfmt.step_dir_name(step))
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until every enqueued save is committed (or failed)."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._pending == 0,
+                                       timeout=timeout):
+                raise TimeoutError(
+                    f"checkpoint flush timed out after {timeout}s with "
+                    f"{self._pending} saves pending")
+        self._reraise()
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        if self._closed:
+            return
+        self.flush(timeout)
+        self._closed = True
+        self._queue.put(None)  # wake + stop the worker
+        self._worker.join(timeout=5.0)
+
+    def __enter__(self) -> "AsyncCheckpointWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def in_flight(self) -> int:
+        return self._pending
+
+    def latest_step(self) -> Optional[int]:
+        return ckfmt.latest_step(self.root)
+
+    # ------------------------------------------------------------- internals
+    def _next_auto_step(self) -> int:
+        if self._auto_step is None:
+            latest = ckfmt.latest_step(self.root)
+            self._auto_step = 0 if latest is None else latest + 1
+        step = self._auto_step
+        self._auto_step += 1
+        return step
+
+    def _reraise(self) -> None:
+        with self._error_lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise RuntimeError(
+                f"background checkpoint write failed: {err}") from err
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            step, host, mesh_spec, fut = item
+            t0 = time.perf_counter()
+            try:
+                with span("ckpt_write", step=step):
+                    path = ckfmt.write_checkpoint(
+                        self.root, step, host, mesh_spec=mesh_spec,
+                        between_files=self.between_files)
+                manifest = ckfmt.read_manifest(self.root, step)
+                _M_BYTES.inc(manifest.get("total_bytes", 0))
+                _M_SAVES.inc()
+                _M_LAST_STEP.set(step)
+                ckfmt.prune(self.root, self.keep, protect=(step,))
+                fut.set_result(path)
+            except BaseException as e:  # noqa: BLE001 — relay, don't die
+                _M_ERRORS.inc()
+                with self._error_lock:
+                    self._error = e
+                fut.set_exception(e)
+            finally:
+                _M_IN_FLIGHT.dec()
+                _M_WRITE_S.observe(time.perf_counter() - t0)
+                with self._cond:
+                    self._pending -= 1
+                    self._cond.notify_all()

@@ -9,9 +9,14 @@ the online endpoint over serving/ (docs/SERVING.md).
 
 Usage:
     python -m deeplearning4j_tpu.cli train   -i data.csv -m conf.json -o model.ckpt
+    python -m deeplearning4j_tpu.cli train   ... --checkpoint-dir ckpts/
     python -m deeplearning4j_tpu.cli test    -i data.csv -m model.ckpt
     python -m deeplearning4j_tpu.cli predict -i data.csv -m model.ckpt -o preds.csv
     python -m deeplearning4j_tpu.cli serve   -m model.ckpt --port 8000
+    python -m deeplearning4j_tpu.cli checkpoint inspect ckpts/
+
+`-m` accepts a conf .json (fresh net), a single-file .ckpt, or a sharded
+checkpoint DIRECTORY (docs/CHECKPOINTS.md) for train/test/predict/serve.
 
 Telemetry (docs/OBSERVABILITY.md): `serve` answers GET /metrics on its
 own port; `--metrics-port N` (train and serve) additionally starts a
@@ -27,6 +32,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Optional, Tuple
 
@@ -58,9 +64,10 @@ def _load_model(path: str):
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     from deeplearning4j_tpu.scaleout.checkpoint import load_checkpoint
 
-    if path.endswith(".json"):  # fresh net from conf JSON
-        with open(path) as f:
+    if path.endswith(".json") and not os.path.isdir(path):
+        with open(path) as f:  # fresh net from conf JSON
             return MultiLayerNetwork.from_config_json(f.read())
+    # load_checkpoint dispatches: npz file OR sharded checkpoint dir
     net, _ = load_checkpoint(path)
     return net
 
@@ -122,7 +129,24 @@ def cmd_train(args) -> int:
             print("train requires labels (--label-columns >= 1)",
                   file=sys.stderr)
             return 2
-        net.fit(x, y, epochs=args.epochs)
+        saver = None
+        if args.checkpoint_every is not None and not args.checkpoint_dir:
+            # refusing beats a run the user believes is checkpointed
+            print("--checkpoint-every needs --checkpoint-dir DIR "
+                  "(where the autosaves go)", file=sys.stderr)
+            return 2
+        if args.checkpoint_dir:
+            # sharded async autosaves off the hot path (docs/CHECKPOINTS.md)
+            from deeplearning4j_tpu.checkpoint import ShardedModelSaver
+
+            saver = ShardedModelSaver(args.checkpoint_dir)
+        try:
+            net.fit(x, y, epochs=args.epochs, saver=saver,
+                    checkpoint_every=(args.checkpoint_every or 1
+                                      if saver is not None else None))
+        finally:
+            if saver is not None:
+                saver.close()  # every pending autosave is durable
         DefaultModelSaver(args.output).save(net)
         score = float(net.score(x, y))
     finally:
@@ -207,6 +231,58 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_checkpoint(args) -> int:
+    """`checkpoint inspect <dir>`: print the sharded-checkpoint manifest
+    — committed steps, source mesh/strategy, cursor, and the per-leaf
+    layout (dtype/global shape/shards/bytes)."""
+    from deeplearning4j_tpu.checkpoint import (leaf_summary, list_steps,
+                                               read_manifest, tree_scalars)
+
+    from deeplearning4j_tpu.checkpoint.restore import resolve_root
+
+    if args.action != "inspect":  # argparse choices already guard this
+        print(f"unknown checkpoint action {args.action!r}", file=sys.stderr)
+        return 2
+    root, pinned = resolve_root(args.dir)  # root OR one step dir
+    steps = list_steps(root)
+    if not steps:
+        print(f"no committed sharded checkpoint under {args.dir!r}",
+              file=sys.stderr)
+        return 2
+    step = args.step if args.step is not None else pinned
+    manifest = read_manifest(root, step)
+    # scalars only — inspect must stay O(manifest), never read shards
+    payload = tree_scalars(manifest)
+    leaves = leaf_summary(manifest)
+    out = {
+        "dir": root,
+        "steps": steps,
+        "step": manifest["step"],
+        "saved_at": manifest.get("saved_at"),
+        "mesh": manifest.get("mesh"),
+        "format_version": payload.get("format_version"),
+        "iterator_position": payload.get("iterator_position"),
+        "iteration_count": payload.get("iteration_count"),
+        "metadata": {k: v for k, v in payload.get("metadata", {}).items()
+                     if isinstance(v, (str, int, float, bool, type(None)))},
+        "total_bytes": manifest.get("total_bytes"),
+        "n_leaves": len(leaves),
+    }
+    if args.json:
+        out["leaves"] = [{**row, "shape": list(row["shape"])}
+                         for row in leaves]
+        print(json.dumps(out))
+        return 0
+    print(json.dumps(out, indent=2))
+    print(f"{'leaf':40s} {'dtype':10s} {'shape':18s} {'shards':>6s} "
+          f"{'bytes':>12s}")
+    for row in leaves:
+        print(f"{row['leaf']:40s} {row['dtype']:10s} "
+              f"{str(row['shape']):18s} {row['shards']:>6d} "
+              f"{row['bytes']:>12d}")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="deeplearning4j_tpu",
@@ -216,7 +292,8 @@ def build_parser() -> argparse.ArgumentParser:
     def common(p, output_required):
         p.add_argument("--input", "-i", required=True, help="input CSV")
         p.add_argument("--model", "-m", required=True,
-                       help="conf .json (fresh net) or .ckpt checkpoint")
+                       help="conf .json (fresh net), .ckpt checkpoint, or "
+                            "sharded checkpoint dir")
         p.add_argument("--label-columns", type=int, default=1,
                        help="trailing label columns (1 = integer class)")
         if output_required is not None:
@@ -234,6 +311,15 @@ def build_parser() -> argparse.ArgumentParser:
     p_train = sub.add_parser("train", help="fit a model and checkpoint it")
     common(p_train, True)
     p_train.add_argument("--epochs", type=int, default=1)
+    p_train.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                         help="write sharded async autosaves here during "
+                              "the fit (docs/CHECKPOINTS.md); restorable "
+                              "on any topology via -m DIR")
+    p_train.add_argument("--checkpoint-every", type=int, default=None,
+                         metavar="N",
+                         help="autosave cadence in fit ticks (requires "
+                              "--checkpoint-dir; default 1 when the dir "
+                              "is set)")
     telemetry_flags(p_train)
     p_train.set_defaults(fn=cmd_train)
 
@@ -245,10 +331,24 @@ def build_parser() -> argparse.ArgumentParser:
     common(p_pred, False)
     p_pred.set_defaults(fn=cmd_predict, label_columns=0)
 
+    p_ckpt = sub.add_parser(
+        "checkpoint",
+        help="inspect sharded checkpoints (docs/CHECKPOINTS.md)")
+    p_ckpt.add_argument("action", choices=["inspect"],
+                        help="inspect: print a checkpoint's manifest")
+    p_ckpt.add_argument("dir", help="checkpoint root (or one step dir)")
+    p_ckpt.add_argument("--step", type=int, default=None,
+                        help="inspect this step (default: latest committed)")
+    p_ckpt.add_argument("--json", action="store_true",
+                        help="single-line machine-readable output incl. "
+                             "the full leaf table")
+    p_ckpt.set_defaults(fn=cmd_checkpoint)
+
     p_serve = sub.add_parser(
         "serve", help="serve a model over HTTP (docs/SERVING.md)")
     p_serve.add_argument("--model", "-m", required=True,
-                         help="conf .json (fresh net) or .ckpt checkpoint")
+                         help="conf .json (fresh net), .ckpt checkpoint, "
+                              "or sharded checkpoint dir")
     p_serve.add_argument("--host", default="127.0.0.1")
     p_serve.add_argument("--port", type=int, default=0,
                          help="0 = auto-assign (printed on start)")

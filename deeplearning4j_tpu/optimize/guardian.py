@@ -40,8 +40,13 @@ tiers:
 3. **Autosave + preemption flush** (`TrainingGuard`): `checkpoint_every=`
    on `fit`/`fit_scan`/the trainers saves a full resumable checkpoint
    (params, updater state, iterator cursor) through the rotating
-   `DefaultModelSaver`; a SIGTERM handler (TPU-VM preemption notice)
-   defers to the next step boundary, flushes a final checkpoint and
+   `DefaultModelSaver` — or, pass a
+   `checkpoint.ShardedModelSaver` and the autosave goes through the
+   ASYNC sharded writer: the step loop pays only the device→host
+   snapshot while serialize+IO overlap training, and the guard flushes
+   pending writes on exit (docs/CHECKPOINTS.md). A SIGTERM handler
+   (TPU-VM preemption notice) defers to the next step boundary, flushes
+   a final checkpoint (synchronously — the process is dying) and
    raises `TrainingPreempted` with the checkpoint path.
 
 Guardian events (skips, rollbacks, saves, aborts) surface through any
@@ -434,6 +439,20 @@ class TrainingGuard:
         for sig, prev in self._prev_handlers.items():
             _signal.signal(sig, prev)
         self._prev_handlers.clear()
+        # async savers (checkpoint.ShardedModelSaver): the fit loop only
+        # paid the snapshot per autosave — make every pending write
+        # durable before fit() returns. On an exceptional exit, still
+        # try, but never mask the in-flight exception with a flush error.
+        flush = getattr(self.saver, "flush", None)
+        if flush is not None:
+            if exc and exc[0] is not None:
+                try:
+                    flush()
+                except Exception:
+                    log.exception(
+                        "checkpoint flush failed during exceptional exit")
+            else:
+                flush()
 
     def _on_signal(self, signum, frame) -> None:
         # defer: the flush must happen at a step boundary, not inside a

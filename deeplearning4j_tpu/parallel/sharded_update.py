@@ -206,10 +206,11 @@ class ShardedUpdateTrainer(DataParallelTrainer):
             device_feed: Optional[bool] = None, guardian=None,
             checkpoint_every: Optional[int] = None, saver=None) -> None:
         """ZeRO-1 fit; guardian/autosave semantics as DataParallelTrainer.
-        Autosaved checkpoints carry the replica-sharded flat optimizer
-        state (host-gathered) under metadata['zero1_flat_state'] — restore
-        it with `restore_flat_state(info['metadata'])` after rebuilding
-        the trainer."""
+        Autosaves host-gather the replica-sharded flat optimizer state
+        into the checkpoint's canonical per-layer form (unpadded — any
+        device count restores it); reinstall with
+        `restore_flat_state(info['metadata'])` after rebuilding the
+        trainer on the restored network (docs/CHECKPOINTS.md)."""
         net = self.network
 
         def gather(a):
@@ -235,12 +236,25 @@ class ShardedUpdateTrainer(DataParallelTrainer):
                 # process) carry the full flat state.
                 meta["zero1_flat_state_skipped"] = (
                     "multi-host preemption flush skips the optimizer-state "
-                    "allgather; resume from the last periodic autosave's "
-                    "zero1_flat_state")
+                    "allgather; resume optimizer state from the last "
+                    "periodic autosave")
             else:
-                meta["zero1_flat_state"] = {
-                    "hist": gather(hist_), "velocity": gather(vel_),
-                    "iteration": np.asarray(it_)}
+                n = ravel_pytree(net._params)[0].size
+                # the gathered vectors land UNPADDED (padding is a
+                # property of the SAVING mesh's device count) in the
+                # CANONICAL per-layer form on the network — one copy in
+                # the checkpoint, restorable bit-identically by
+                # DP/TP/single-device runs directly and by any-device-
+                # count ZeRO-1 via restore_flat_state (which still also
+                # reads the legacy metadata['zero1_flat_state'] blob
+                # older checkpoints carry). checkpoint/convert.py keeps
+                # this a pure host reshape — no device round trip on the
+                # save path.
+                from deeplearning4j_tpu.checkpoint.convert import \
+                    flat_to_updater_state
+                net._updater_state = flat_to_updater_state(
+                    gather(hist_)[:n], gather(vel_)[:n], np.asarray(it_),
+                    net._params)
             return saver_.save(net, iterator_position=position,
                                metadata=meta)
 
@@ -311,13 +325,48 @@ class ShardedUpdateTrainer(DataParallelTrainer):
             for listener in net.listeners:
                 listener.iteration_done(net, steps - 1, score_f)
 
-    def restore_flat_state(self, metadata: dict) -> None:
-        """Reinstall the flat optimizer state an autosaved checkpoint
-        carried (metadata['zero1_flat_state']), re-sharding it over the
-        mesh's data axis."""
-        state = metadata["zero1_flat_state"]
+    def restore_flat_state(self, metadata: Optional[dict] = None) -> None:
+        """Reinstall the optimizer state from a checkpoint, re-sharding
+        it over THIS trainer's mesh — the device count/parallelism it
+        was saved under no longer matters:
+
+        - `metadata` carrying `zero1_flat_state` (a LEGACY ZeRO-1
+          autosave): vectors are taken unpadded (older checkpoints saved
+          them padded to the SOURCE mesh — the tail is stripped),
+          re-padded to this mesh's width, and re-sharded over the data
+          axis.
+        - `metadata=None` (or no flat state present): the canonical
+          per-layer UpdaterState tree on the network — i.e. a checkpoint
+          written by a DP/TP/single-device run — is flattened into the
+          ZeRO-1 vectors (checkpoint/convert.py). Bit-identical either
+          way: both conversions are pure reshapes.
+        """
+        net = self.network
+        n = ravel_pytree(net._params)[0].size
+        state = (metadata or {}).get("zero1_flat_state")
+        if state is not None:
+            hist = np.asarray(state["hist"])
+            vel = np.asarray(state["velocity"])
+            it = np.asarray(state["iteration"])
+            if hist.size < n or vel.size < n:
+                raise ValueError(
+                    f"zero1_flat_state packs {min(hist.size, vel.size)} "
+                    f"elements but this network packs {n} — checkpoint "
+                    "does not match the architecture")
+            hist, vel = hist[:n], vel[:n]
+        else:
+            if net._updater_state is None:
+                raise ValueError(
+                    "no optimizer state to restore: metadata carries no "
+                    "zero1_flat_state and the network has no updater "
+                    "state (checkpoint saved before any training step?)")
+            from deeplearning4j_tpu.checkpoint.convert import \
+                updater_state_to_flat
+            hist, vel, it = updater_state_to_flat(net._updater_state,
+                                                  net._params)
+        pad = self._pad(n) - n
         shard = NamedSharding(self.mesh, P(self.axis))
         self._flat_state = (
-            jax.device_put(jnp.asarray(state["hist"]), shard),
-            jax.device_put(jnp.asarray(state["velocity"]), shard),
-            jnp.asarray(state["iteration"], jnp.int32))
+            jax.device_put(jnp.asarray(np.pad(hist, (0, pad))), shard),
+            jax.device_put(jnp.asarray(np.pad(vel, (0, pad))), shard),
+            jnp.asarray(it, jnp.int32))

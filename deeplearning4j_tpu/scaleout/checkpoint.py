@@ -15,6 +15,12 @@ load (`allow_pickle=False`), so loading a checkpoint from a shared/cloud
 path is safe: worst case is a ValueError, never code execution. (On a real
 pod this file lands on GCS; the writer below only assumes a filesystem
 path. An orbax-backed saver can implement the same two calls.)
+
+This single-file format is now the COMPATIBILITY tier: the production
+path is the sharded async directory format in
+`deeplearning4j_tpu.checkpoint` (per-device shard files, atomic commit
+marker, background writer, cross-topology resharded restore —
+docs/CHECKPOINTS.md). `load_checkpoint` below transparently loads both.
 """
 
 from __future__ import annotations
@@ -326,9 +332,19 @@ def load_checkpoint(path: str):
 
     Returns (network, info) where info carries iterator_position/metadata
     for the caller to restore data-pipeline state.
+
+    `path` may be a single-file npz checkpoint (this module's format, the
+    compatibility shim) or a sharded checkpoint directory
+    (deeplearning4j_tpu.checkpoint, format_version 3) — directories
+    delegate to the resharded loader, which reassembles global arrays
+    from per-device shards and restores onto ANY topology.
     """
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
+    if os.path.isdir(path):
+        from deeplearning4j_tpu.checkpoint import restore_network
+
+        return restore_network(path)
     with open(path, "rb") as f:
         data = f.read()
     if data[:2] == b"\x80\x04" or not data.startswith(b"PK"):

@@ -257,6 +257,31 @@ class InferenceEngine:
                           time.perf_counter() - start)
         return out
 
+    # ------------------------------------------------------- hot reload
+    def load_params(self, params) -> None:
+        """Swap this engine's weights in place — zero-downtime reload.
+
+        Validates the new tree leaf-for-leaf (structure + shapes, error
+        naming the first mismatched leaf) and device_puts it onto the
+        engine's device BEFORE the swap, so the visible transition is a
+        single reference assignment: requests in flight keep the old
+        params they already closed over, later requests see the new ones
+        — nothing is dropped and no lock sits on the request path. The
+        compiled bucket programs are reused as-is (params are a traced
+        argument, so same shapes = same program)."""
+        import jax
+
+        from deeplearning4j_tpu.checkpoint.restore import validate_like
+
+        validate_like(params, self._params, context="engine reload")
+        if self.device is not None:
+            params = jax.device_put(params, self.device)
+        else:
+            import jax.numpy as jnp
+
+            params = jax.tree_util.tree_map(jnp.asarray, params)
+        self._params = params  # atomic swap
+
     # ---------------------------------------------------- observability
     def warmup(self, feature_shape: Sequence[int],
                dtype=np.float32) -> None:

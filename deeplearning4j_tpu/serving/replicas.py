@@ -68,6 +68,43 @@ class ReplicaSet:
         over the replicas."""
         return MicroBatcher(self.infer, **kw)
 
+    # --------------------------------------------------------- hot reload
+    def load_params(self, params) -> None:
+        """Swap every replica's weights (each engine validates shapes
+        and swaps atomically — in-flight requests finish on the old
+        params; see InferenceEngine.load_params)."""
+        for engine in self.engines:
+            engine.load_params(params)
+
+    def load_checkpoint(self, path: str, step: Optional[int] = None) -> dict:
+        """Hot-reload all replicas from a checkpoint — a sharded
+        directory (deeplearning4j_tpu.checkpoint) or a legacy single-file
+        npz — without dropping in-flight requests. The checkpoint's
+        params tree must match the serving model's architecture (the
+        per-leaf validation errors name the first mismatched leaf).
+        Returns the checkpoint's info dict (step/cursor/metadata)."""
+        import os
+
+        if os.path.isdir(path):
+            from deeplearning4j_tpu.checkpoint import restore_network
+
+            net, info = restore_network(path, step)
+        else:
+            if step is not None:
+                # a single-file checkpoint holds exactly one state —
+                # silently serving it against an explicit step pin would
+                # defeat a rollback-to-step intent
+                raise ValueError(
+                    f"step={step} was requested but {path!r} is a "
+                    "single-file checkpoint with no steps; point at a "
+                    "sharded checkpoint directory to pin a step")
+            from deeplearning4j_tpu.scaleout.checkpoint import \
+                load_checkpoint
+
+            net, info = load_checkpoint(path)
+        self.load_params(net.param_table)
+        return info
+
     # ---------------------------------------------------- observability
     def program_cache_size(self) -> int:
         sizes = [e.program_cache_size() for e in self.engines]

@@ -11,9 +11,14 @@ thread, port-0 auto-assign, graceful close. Endpoints:
 - ``POST /generate`` {"prompt": [[...tokens]], "n_tokens": N} ->
   {"tokens": [[...]]} — KV-cached decode (requires a transformer
   engine; 404 otherwise).
+- ``POST /reload``   {"path": "<checkpoint dir or .ckpt>", "step": N?}
+  — hot-swap every replica's weights from a checkpoint
+  (docs/CHECKPOINTS.md) WITHOUT dropping in-flight requests: each
+  engine validates shapes, stages the new params on its device, then
+  swaps by a single reference assignment.
 - ``GET /healthz``   liveness + replica count.
 - ``GET /stats``     replica + batcher (queue depth, per-bucket forward
-  counts) + uptime counters.
+  counts) + uptime counters + last reload.
 - ``GET /metrics``   Prometheus text exposition of the process-global
   telemetry registry (train/serve/guardian/device series —
   docs/OBSERVABILITY.md); ``GET /snapshot`` is the JSON twin.
@@ -34,12 +39,16 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.serving.engine import InferenceEngine
 from deeplearning4j_tpu.serving.replicas import ReplicaSet
 from deeplearning4j_tpu.telemetry import exposition
 from deeplearning4j_tpu.utils.httpd import ServerHandle, start_http_server
 
 __all__ = ["ServingHandle", "serve_network"]
+
+_M_RELOADS = telemetry.counter(
+    "dl4j_serve_reloads", "hot checkpoint reloads applied to the replicas")
 
 #: per-request wait on the batcher future — generous; the batcher bounds
 #: queueing at max_delay_ms, so hitting this means the engine died
@@ -62,6 +71,7 @@ class ServingHandle:
         self.batcher = batcher
         self.generate_engine = generate_engine
         self.started_at = time.time()
+        self.last_reload: Optional[dict] = None
 
     @property
     def url(self) -> str:
@@ -91,7 +101,23 @@ class ServingHandle:
             out["batcher"] = self.batcher.snapshot()
         if self.generate_engine is not None:
             out["generate"] = self.generate_engine.snapshot()
+        if self.last_reload is not None:
+            out["last_reload"] = self.last_reload
         return out
+
+    def load_checkpoint(self, path: str, step: Optional[int] = None) -> dict:
+        """Hot-swap replica weights from a checkpoint path (sharded dir
+        or legacy npz) without dropping in-flight requests; records the
+        reload in /stats. The HTTP `/reload` route calls this."""
+        info = self.replicas.load_checkpoint(path, step=step)
+        self.last_reload = {
+            "path": path,
+            "step": info.get("step"),
+            "iterator_position": info.get("iterator_position"),
+            "at": time.time(),
+        }
+        _M_RELOADS.inc()
+        return info
 
 
 def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
@@ -167,8 +193,12 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                     self._predict()
                 elif self.path.startswith("/generate"):
                     self._generate()
+                elif self.path.startswith("/reload"):
+                    self._reload()
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
+            except FileNotFoundError as e:
+                self._reply(404, {"error": str(e)})
             except (ValueError, KeyError, TypeError) as e:
                 self._reply(400, {"error": str(e)})
             except Exception as e:  # engine-side failure
@@ -182,6 +212,21 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
             self._reply(200, {
                 "outputs": np.asarray(out).tolist(),
                 "classes": np.argmax(out, axis=-1).astype(int).tolist(),
+            })
+
+        def _reload(self):
+            data = self._read_json()
+            path = data.get("path")
+            if not path:
+                raise ValueError("reload needs {'path': <checkpoint>}")
+            step = data.get("step")
+            info = handle.load_checkpoint(
+                str(path), step=None if step is None else int(step))
+            self._reply(200, {
+                "reloaded": True,
+                "step": info.get("step"),
+                "iterator_position": info.get("iterator_position"),
+                "replicas": len(replicas.engines),
             })
 
         def _generate(self):

@@ -21,7 +21,11 @@ process-global `MetricsRegistry`:
   `dl4j_serve_latency_seconds`, `dl4j_serve_bucket_forwards`,
   `dl4j_batcher_*` + queue depth;
 - device: `dl4j_device_memory_bytes{device=,stat=}`,
-  `dl4j_jit_programs{cache=}` recompile counters.
+  `dl4j_jit_programs{cache=}` recompile counters;
+- checkpoint: `dl4j_ckpt_saves/bytes_written/errors`,
+  `dl4j_ckpt_snapshot_seconds` (step-loop stall) /
+  `dl4j_ckpt_write_seconds`, in-flight + last-committed-step gauges,
+  `dl4j_serve_reloads` (docs/CHECKPOINTS.md).
 
 Export: `GET /metrics` (Prometheus text) and `GET /snapshot` (JSON) on
 the serving server, the scaleout StatusServer, or a standalone

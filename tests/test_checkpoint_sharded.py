@@ -1,0 +1,667 @@
+"""Distributed checkpoint subsystem drills (deeplearning4j_tpu/checkpoint).
+
+Four fronts: the sharded directory format (manifest + per-shard files +
+atomic commit marker), the async writer (snapshot-only stall, bounded
+in-flight, rotation, crash-mid-save atomicity), the cross-topology
+resharded restore matrix (ZeRO-1 ↔ DP ↔ TP, 8 ↔ 2 ↔ 1 devices,
+bit-identical params + updater state + cursor), and the TrainingGuard
+autosave integration. Serving hot-reload e2e lives in
+test_serving_http.py; CLI surface in test_cli.py.
+"""
+
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.checkpoint import (
+    AsyncCheckpointWriter,
+    CorruptShardError,
+    ShardedModelSaver,
+    flat_to_updater_state,
+    latest_step,
+    list_steps,
+    load_tree,
+    read_manifest,
+    restore_network,
+    restore_params_for,
+    snapshot_tree,
+    updater_state_to_flat,
+    write_checkpoint,
+)
+from deeplearning4j_tpu.checkpoint import format as ckfmt
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.datasets import ListDataSetIterator
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.updater import UpdaterState
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.scaleout.checkpoint import load_checkpoint
+
+
+def _conf(lr=0.1):
+    return (NeuralNetConfiguration.builder()
+            .lr(lr).n_in(4).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False).momentum(0.5)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=3)
+            .pretrain(False).build())
+
+
+def _net():
+    return MultiLayerNetwork(_conf())
+
+
+def _data(n=96, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return x, y
+
+
+def _payload():
+    import jax.numpy as jnp
+
+    return {
+        "params": {"0": {"W": np.arange(12, dtype=np.float32).reshape(3, 4),
+                         "b": jnp.ones((1, 4), jnp.bfloat16)}},
+        "updater_state": {"0": UpdaterState(
+            hist=np.zeros(3, np.float32), velocity=np.ones(3, np.float32),
+            iteration=np.int32(5))},
+        "cursor": 7,
+        "none": None,
+        "mixed": (1, [2.5, "tag"], {"k": True}),
+    }
+
+
+# ===================================================================== format
+class TestFormat:
+    def test_round_trip_preserves_tree_and_dtypes(self, tmp_path):
+        root = str(tmp_path)
+        write_checkpoint(root, 3, snapshot_tree(_payload()))
+        back, manifest = load_tree(root)
+        assert manifest["step"] == 3
+        np.testing.assert_array_equal(back["params"]["0"]["W"],
+                                      np.arange(12).reshape(3, 4))
+        assert str(back["params"]["0"]["b"].dtype) == "bfloat16"
+        st = back["updater_state"]["0"]
+        assert isinstance(st, UpdaterState)
+        assert int(st.iteration) == 5 and st.iteration.shape == ()
+        assert back["cursor"] == 7 and back["none"] is None
+        assert back["mixed"] == (1, [2.5, "tag"], {"k": True})
+
+    def test_sharded_leaf_writes_one_file_per_device_slice(self, tmp_path):
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = make_mesh({"data": 8})
+        flat = jax.device_put(jnp.arange(32, dtype=jnp.float32),
+                              NamedSharding(mesh, P("data")))
+        root = str(tmp_path)
+        write_checkpoint(root, 1, snapshot_tree({"flat": flat}))
+        manifest = read_manifest(root)
+        shards = manifest["leaves"]["flat"]["shards"]
+        assert len(shards) == 8
+        assert [s["index"][0] for s in shards] == \
+            [[i * 4, (i + 1) * 4] for i in range(8)]
+        back, _ = load_tree(root)
+        np.testing.assert_array_equal(back["flat"], np.arange(32))
+
+    def test_replicated_leaf_collapses_to_one_shard(self, tmp_path):
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = make_mesh({"data": 8})
+        rep = jax.device_put(jnp.arange(6.0), NamedSharding(mesh, P()))
+        write_checkpoint(str(tmp_path), 1, snapshot_tree({"rep": rep}))
+        manifest = read_manifest(str(tmp_path))
+        assert len(manifest["leaves"]["rep"]["shards"]) == 1
+
+    def test_corrupt_shard_error_names_the_leaf(self, tmp_path):
+        root = str(tmp_path)
+        path = write_checkpoint(root, 2, snapshot_tree(_payload()))
+        victim = [f for f in os.listdir(path)
+                  if f.startswith("params__0__W")][0]
+        with open(os.path.join(path, victim), "r+b") as f:
+            f.seek(-4, os.SEEK_END)
+            f.write(b"\xde\xad\xbe\xef")
+        with pytest.raises(CorruptShardError, match="params/0/W"):
+            load_tree(root)
+
+    def test_unsupported_leaf_type_names_the_path(self, tmp_path):
+        with pytest.raises(TypeError, match="bad/obj"):
+            write_checkpoint(str(tmp_path), 0,
+                             {"bad": {"obj": object()}})
+
+    def test_uncommitted_steps_are_invisible(self, tmp_path):
+        root = str(tmp_path)
+        write_checkpoint(root, 1, snapshot_tree(_payload()))
+        # fake a torn step 2: files but no marker
+        torn = os.path.join(root, ckfmt.step_dir_name(2))
+        os.makedirs(torn)
+        with open(os.path.join(torn, ckfmt.MANIFEST), "w") as f:
+            f.write("{}")
+        assert list_steps(root) == [1]
+        assert latest_step(root) == 1
+        _, manifest = load_tree(root)
+        assert manifest["step"] == 1
+
+    def test_prune_keeps_newest_and_clears_torn_dirs(self, tmp_path):
+        root = str(tmp_path)
+        for step in (1, 2, 3):
+            write_checkpoint(root, step, snapshot_tree(_payload()))
+        os.makedirs(os.path.join(root, ckfmt.step_dir_name(9)))  # torn
+        removed = ckfmt.prune(root, keep=2)
+        assert removed == [1, 9]
+        assert list_steps(root) == [2, 3]
+
+    def test_restore_params_for_reshards_to_target(self, tmp_path):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        root = str(tmp_path)
+        saver = ShardedModelSaver(root, sync=True)
+        net = _net()
+        saver.save(net, iterator_position=1)
+        saver.close()
+        mesh2 = make_mesh({"data": 2}, devices=jax.devices()[:2])
+        params = restore_params_for(root, NamedSharding(mesh2, P()))
+        flat_ref = np.asarray(net.params())
+        from jax.flatten_util import ravel_pytree
+        np.testing.assert_array_equal(np.asarray(ravel_pytree(params)[0]),
+                                      flat_ref)
+
+
+# ================================================================= atomicity
+class TestCrashMidSaveAtomicity:
+    """ISSUE satellite: kill the writer between shard files and assert
+    restore selects the last committed checkpoint, never a partial."""
+
+    def _writer(self, root, **kw):
+        return AsyncCheckpointWriter(root, **kw)
+
+    def test_crash_between_shard_files_never_surfaces_partial(self,
+                                                              tmp_path):
+        root = str(tmp_path)
+        w = self._writer(root)
+        w.save(_payload(), step=1)
+        w.flush()
+
+        files_seen = []
+
+        def bomb(fname):
+            files_seen.append(fname)
+            if len(files_seen) == 3:  # mid-save, after some files landed
+                raise OSError("disk died")
+
+        w.between_files = bomb
+        w.save(_payload(), step=2)
+        with pytest.raises(RuntimeError, match="disk died"):
+            w.flush()
+        # the torn step 2 must be invisible; restore finds step 1
+        assert list_steps(root) == [1]
+        back, manifest = load_tree(root)
+        assert manifest["step"] == 1
+        assert back["cursor"] == 7
+        # and the NEXT save garbage-collects the torn dir
+        w.between_files = None
+        w.save(_payload(), step=3)
+        w.flush()
+        assert list_steps(root) == [1, 3]
+        assert not os.path.exists(os.path.join(root,
+                                               ckfmt.step_dir_name(2)))
+        w.close()
+
+    def test_crash_just_before_marker_is_still_invisible(self, tmp_path):
+        root = str(tmp_path)
+        w = self._writer(root)
+        w.save(_payload(), step=1)
+        w.flush()
+
+        def bomb(fname):
+            if fname == ckfmt.MARKER:  # everything written but the commit
+                raise OSError("power cut")
+
+        w.between_files = bomb
+        w.save(_payload(), step=2)
+        with pytest.raises(RuntimeError, match="power cut"):
+            w.flush()
+        assert latest_step(root) == 1
+        w.close()
+
+    def test_recommitting_an_existing_step_stays_loadable(self, tmp_path):
+        root = str(tmp_path)
+        w = self._writer(root)
+        w.save(_payload(), step=5)
+        w.flush()
+        p2 = dict(_payload())
+        p2["cursor"] = 99
+        w.save(p2, step=5)
+        w.flush()
+        back, _ = load_tree(root, 5)
+        assert back["cursor"] == 99
+        w.close()
+
+
+# =============================================================== async writer
+class TestAsyncWriter:
+    def test_save_returns_while_write_is_still_in_flight(self, tmp_path):
+        """The step-loop stall is the SNAPSHOT only: with the background
+        IO gated shut, save() must return and the commit must not have
+        happened yet — deterministically, no timing assumptions."""
+        root = str(tmp_path)
+        w = AsyncCheckpointWriter(root, max_in_flight=2)
+        gate = threading.Event()
+
+        w.between_files = lambda fname: gate.wait(timeout=30)
+        w.save(_payload(), step=1)  # returns: snapshot+enqueue only
+        assert latest_step(root) is None  # commit gated shut
+        assert w.in_flight == 1
+        gate.set()
+        w.flush()
+        assert latest_step(root) == 1
+        assert w.in_flight == 0
+        w.close()
+
+    def test_in_flight_saves_are_bounded(self, tmp_path):
+        """max_in_flight=1: with one save stuck in the worker, a second
+        save() must BLOCK (bounded memory), then complete on release."""
+        root = str(tmp_path)
+        w = AsyncCheckpointWriter(root, max_in_flight=1)
+        gate = threading.Event()
+        w.between_files = lambda fname: gate.wait(timeout=30)
+        w.save(_payload(), step=1)
+
+        second_returned = threading.Event()
+
+        def second():
+            w.save(_payload(), step=2)
+            second_returned.set()
+
+        t = threading.Thread(target=second, daemon=True)
+        t.start()
+        # the queue admits step 2 (1 slot), a THIRD save must block
+        third_returned = threading.Event()
+
+        def third():
+            w.save(_payload(), step=3)
+            third_returned.set()
+
+        t3 = threading.Thread(target=third, daemon=True)
+        t3.start()
+        time.sleep(0.1)
+        assert not third_returned.is_set(), \
+            "third save should block on the bounded queue"
+        gate.set()
+        t.join(timeout=30)
+        t3.join(timeout=30)
+        w.flush()
+        assert list_steps(root) == [1, 2, 3]
+        w.close()
+
+    def test_auto_step_continues_from_disk(self, tmp_path):
+        root = str(tmp_path)
+        w = AsyncCheckpointWriter(root)
+        w.save(_payload(), step=4)
+        w.flush()
+        w.close()
+        w2 = AsyncCheckpointWriter(root)
+        w2.save(_payload())  # auto: 5
+        w2.flush()
+        assert latest_step(root) == 5
+        w2.close()
+
+    def test_rotation_keeps_newest(self, tmp_path):
+        root = str(tmp_path)
+        w = AsyncCheckpointWriter(root, keep=2)
+        for step in range(5):
+            w.save(_payload(), step=step)
+        w.flush()
+        assert list_steps(root) == [3, 4]
+        w.close()
+
+    def test_writer_validates_knobs(self, tmp_path):
+        with pytest.raises(ValueError):
+            AsyncCheckpointWriter(str(tmp_path), max_in_flight=0)
+        with pytest.raises(ValueError):
+            AsyncCheckpointWriter(str(tmp_path), keep=0)
+
+    def test_telemetry_series_update(self, tmp_path):
+        from deeplearning4j_tpu import telemetry
+
+        reg = telemetry.get_registry()
+        saves0 = reg.counter("dl4j_ckpt_saves").value
+        w = AsyncCheckpointWriter(str(tmp_path))
+        w.save(_payload(), step=1)
+        w.flush()
+        w.close()
+        assert reg.counter("dl4j_ckpt_saves").value == saves0 + 1
+        assert reg.counter("dl4j_ckpt_bytes_written").value > 0
+        assert reg.gauge("dl4j_ckpt_last_committed_step").value == 1
+        assert reg.gauge("dl4j_ckpt_in_flight").value == 0
+
+
+# =========================================================== guard integration
+class TestGuardAutosave:
+    """ISSUE satellite: TrainingGuard autosaves route through the async
+    writer — the fit loop pays only the snapshot, pending writes flush
+    before fit() returns."""
+
+    def test_fit_autosaves_overlap_training(self, tmp_path):
+        """ISSUE satellite regression: the step loop must not stall for
+        serialize+write — only for the snapshot. Deterministic proof:
+        with the background IO gated SHUT, all four autosaving train
+        steps still run to completion (the loop would deadlock here if
+        any save blocked on IO); fit() then blocks only in the guard's
+        exit flush until the gate opens."""
+        root = str(tmp_path / "ck")
+        saver = ShardedModelSaver(root, keep=10, max_in_flight=8)
+        gate = threading.Event()
+        saver.writer.between_files = lambda fname: gate.wait(timeout=60)
+
+        x, y = _data(96)  # 4 batches of 24
+        net = _net()
+        fit_done = threading.Event()
+
+        def run_fit():
+            net.fit(ListDataSetIterator(DataSet(x, y), 24),
+                    checkpoint_every=1, saver=saver)
+            fit_done.set()
+
+        t = threading.Thread(target=run_fit, daemon=True)
+        t.start()
+        # all 4 snapshots must be taken with the gate still shut: the
+        # step loop never waited on serialize+write
+        deadline = time.monotonic() + 60
+        while saver.writer.in_flight < 4:
+            assert time.monotonic() < deadline, \
+                "train steps stalled behind gated checkpoint IO"
+            time.sleep(0.01)
+        assert latest_step(root) is None  # nothing committed yet
+        assert not fit_done.is_set()  # fit is parked in the exit flush
+        gate.set()
+        t.join(timeout=60)
+        assert fit_done.is_set()
+        # after fit: the guard flushed — all 4 autosaves committed
+        assert list_steps(root) == [1, 2, 3, 4]
+        saver.close()
+
+    def test_autosaved_checkpoint_is_resumable(self, tmp_path):
+        root = str(tmp_path / "ck")
+        x, y = _data(240)  # 10 batches
+        net = _net()
+        saver = ShardedModelSaver(root, keep=3)
+        net.fit(ListDataSetIterator(DataSet(x, y), 24),
+                checkpoint_every=4, saver=saver)
+        saver.close()
+        assert latest_step(root) == 8  # batches 4 and 8
+        net2, info = restore_network(root)
+        assert info["iterator_position"] == 8
+        assert net2._updater_state is not None
+        assert info["metadata"]["epoch"] == 0
+        # load_checkpoint (the compat entry point) reads the dir too
+        net3, info3 = load_checkpoint(root)
+        np.testing.assert_array_equal(np.asarray(net2.params()),
+                                      np.asarray(net3.params()))
+
+    def test_preemption_flush_is_synchronous_and_committed(self, tmp_path):
+        import os as _os
+        import signal as _signal
+
+        from deeplearning4j_tpu.optimize.guardian import TrainingPreempted
+
+        root = str(tmp_path / "ck")
+        x, y = _data(240)  # 10 batches
+        net = _net()
+        saver = ShardedModelSaver(root)
+
+        class KillAt:
+            def __init__(self, at):
+                self.count = 0
+                self.at = at
+
+            def iteration_done(self, model, it, score):
+                self.count += 1
+                if self.count == self.at:
+                    _os.kill(_os.getpid(), _signal.SIGTERM)
+
+        net.set_listeners([KillAt(3)])
+        with pytest.raises(TrainingPreempted) as exc:
+            net.fit(ListDataSetIterator(DataSet(x, y), 24), saver=saver)
+        # the preempt save is SYNCHRONOUS: committed BEFORE the raise
+        # (the process is dying — an in-flight future would be lost)
+        assert latest_step(root) == exc.value.position == 3
+        _, info = restore_network(root)
+        assert info["metadata"]["save_kind"] == "preempt"
+        assert info["iterator_position"] == 3
+        saver.close()
+
+
+# ============================================================ reshard matrix
+class TestReshardMatrix:
+    """ISSUE acceptance: a ZeRO-1 checkpoint from N devices restores
+    bit-identically (params + updater state + cursor) into DP / TP /
+    single-device configurations, and across device counts 8→2→1."""
+
+    def _zero1_checkpoint(self, tmp_path, mesh, epochs=1):
+        from deeplearning4j_tpu.parallel import ShardedUpdateTrainer
+
+        x, y = _data(96, seed=1)
+        net = MultiLayerNetwork(_conf())
+        tr = ShardedUpdateTrainer(net, mesh)
+        root = str(tmp_path / "z1")
+        saver = ShardedModelSaver(root, mesh=mesh, strategy="zero1")
+        tr.fit(ListDataSetIterator(DataSet(x, y), 24), epochs=epochs,
+               checkpoint_every=4, saver=saver)
+        saver.close()
+        return net, tr, root, (x, y)
+
+    def test_zero1_8dev_restores_bit_identical_on_single_device(
+            self, tmp_path):
+        mesh8 = make_mesh({"data": 8})
+        net, tr, root, _ = self._zero1_checkpoint(tmp_path, mesh8)
+        net1, info = restore_network(root)
+        # params bit-identical
+        np.testing.assert_array_equal(np.asarray(net1.params()),
+                                      np.asarray(net.params()))
+        # cursor round-trips
+        assert info["iterator_position"] == 4
+        assert info["mesh"]["axes"] == {"data": 8}
+        assert info["mesh"]["strategy"] == "zero1"
+        # updater state: canonical tree == the trainer's flat state
+        hist, vel, it = updater_state_to_flat(net1._updater_state,
+                                              net1._params)
+        n = hist.size
+        np.testing.assert_array_equal(
+            hist, np.asarray(tr._flat_state[0])[:n])
+        np.testing.assert_array_equal(
+            vel, np.asarray(tr._flat_state[1])[:n])
+        assert int(it) == int(tr._flat_state[2])
+
+    def test_zero1_8_to_2_to_1_device_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.parallel import ShardedUpdateTrainer
+
+        mesh8 = make_mesh({"data": 8})
+        net8, tr8, root, (x, y) = self._zero1_checkpoint(tmp_path, mesh8)
+        ref_hist = np.asarray(tr8._flat_state[0])
+        n = np.asarray(net8.params()).size
+
+        # ---- 8 -> 2
+        mesh2 = make_mesh({"data": 2}, devices=jax.devices()[:2])
+        net2, info2 = restore_network(root)
+        tr2 = ShardedUpdateTrainer(net2, mesh2)
+        tr2.restore_flat_state(info2["metadata"])
+        np.testing.assert_array_equal(np.asarray(tr2._flat_state[0])[:n],
+                                      ref_hist[:n])
+        # continue training on the new topology and re-checkpoint
+        root2 = str(tmp_path / "z1_2dev")
+        saver2 = ShardedModelSaver(root2, mesh=mesh2, strategy="zero1")
+        tr2.fit(ListDataSetIterator(DataSet(x, y), 24), epochs=1,
+                checkpoint_every=4, saver=saver2)
+        saver2.close()
+
+        # the 8-device original continues identically (same math,
+        # different sharding): params must agree to float tolerance
+        tr8.fit(ListDataSetIterator(DataSet(x, y), 24), epochs=1)
+        np.testing.assert_allclose(np.asarray(net2.params()),
+                                   np.asarray(net8.params()), atol=1e-5)
+
+        # ---- 2 -> 1
+        mesh1 = make_mesh({"data": 1}, devices=jax.devices()[:1])
+        net1, info1 = restore_network(root2)
+        tr1 = ShardedUpdateTrainer(net1, mesh1)
+        tr1.restore_flat_state(info1["metadata"])
+        np.testing.assert_array_equal(
+            np.asarray(tr1._flat_state[0])[:n],
+            np.asarray(tr2._flat_state[0])[:n])
+        np.testing.assert_array_equal(np.asarray(net1.params()),
+                                      np.asarray(net2.params()))
+
+    def test_zero1_checkpoint_continues_under_dp(self, tmp_path):
+        from deeplearning4j_tpu.parallel import ShardedUpdateTrainer
+        from deeplearning4j_tpu.parallel.data_parallel import \
+            DataParallelTrainer
+
+        mesh8 = make_mesh({"data": 8})
+        net_z, tr_z, root, (x, y) = self._zero1_checkpoint(tmp_path, mesh8)
+        net_dp, _ = restore_network(root)
+        dp = DataParallelTrainer(net_dp, mesh8)
+        dp.fit(ListDataSetIterator(DataSet(x, y), 24), epochs=1)
+        tr_z.fit(ListDataSetIterator(DataSet(x, y), 24), epochs=1)
+        np.testing.assert_allclose(np.asarray(net_dp.params()),
+                                   np.asarray(net_z.params()), atol=1e-5)
+
+    def test_zero1_checkpoint_continues_under_tp(self, tmp_path):
+        from deeplearning4j_tpu.parallel import ShardedUpdateTrainer
+        from deeplearning4j_tpu.parallel.tensor_parallel import \
+            TensorParallelTrainer
+
+        mesh8 = make_mesh({"data": 8})
+        net_z, tr_z, root, (x, y) = self._zero1_checkpoint(tmp_path, mesh8)
+        mesh_tp = make_mesh({"data": 4, "model": 2})
+        net_tp, _ = restore_network(root)
+        tp = TensorParallelTrainer(net_tp, mesh_tp)
+        tp.fit(ListDataSetIterator(DataSet(x, y), 24), epochs=1)
+        tr_z.fit(ListDataSetIterator(DataSet(x, y), 24), epochs=1)
+        np.testing.assert_allclose(np.asarray(net_tp.params()),
+                                   np.asarray(net_z.params()), atol=1e-5)
+
+    def test_dp_checkpoint_restores_into_zero1(self, tmp_path):
+        """The reverse direction: a DP-saved canonical checkpoint feeds
+        a ZeRO-1 trainer via the tree→flat conversion."""
+        from deeplearning4j_tpu.parallel import ShardedUpdateTrainer
+        from deeplearning4j_tpu.parallel.data_parallel import \
+            DataParallelTrainer
+
+        mesh8 = make_mesh({"data": 8})
+        x, y = _data(96, seed=2)
+        net = MultiLayerNetwork(_conf())
+        dp = DataParallelTrainer(net, mesh8)
+        root = str(tmp_path / "dp")
+        saver = ShardedModelSaver(root, mesh=mesh8, strategy="dp")
+        dp.fit(ListDataSetIterator(DataSet(x, y), 24), epochs=1,
+               checkpoint_every=4, saver=saver)
+        saver.close()
+
+        mesh2 = make_mesh({"data": 2}, devices=jax.devices()[:2])
+        net_z, info = restore_network(root)
+        tr = ShardedUpdateTrainer(net_z, mesh2)
+        tr.restore_flat_state(info["metadata"])  # no zero1_flat_state:
+        # falls through to the canonical per-layer UpdaterState tree
+        hist, vel, it = updater_state_to_flat(net_z._updater_state,
+                                              net_z._params)
+        n = hist.size
+        np.testing.assert_array_equal(np.asarray(tr._flat_state[0])[:n],
+                                      hist)
+        # and training continues equivalently on both
+        tr.fit(ListDataSetIterator(DataSet(x, y), 24), epochs=1)
+        dp.fit(ListDataSetIterator(DataSet(x, y), 24), epochs=1)
+        np.testing.assert_allclose(np.asarray(net_z.params()),
+                                   np.asarray(net.params()), atol=1e-5)
+
+    def test_architecture_mismatch_names_the_problem(self, tmp_path):
+        from deeplearning4j_tpu.parallel import ShardedUpdateTrainer
+
+        wide = (NeuralNetConfiguration.builder()
+                .lr(0.1).n_in(4).activation_function("tanh")
+                .optimization_algo("iteration_gradient_descent")
+                .num_iterations(1).use_adagrad(False)
+                .list(2).hidden_layer_sizes([16])
+                .override(1, layer="output", loss_function="mcxent",
+                          activation_function="softmax", n_out=3)
+                .pretrain(False).build())
+        net_wide = MultiLayerNetwork(wide)
+        tr = ShardedUpdateTrainer(net_wide,
+                                  make_mesh({"data": 2},
+                                            devices=jax.devices()[:2]))
+        # a legacy checkpoint's flat blob sized for a SMALLER net
+        legacy = {"zero1_flat_state": {
+            "hist": np.zeros(8, np.float32),
+            "velocity": np.zeros(8, np.float32),
+            "iteration": np.int32(0)}}
+        with pytest.raises(ValueError, match="does not match"):
+            tr.restore_flat_state(legacy)
+        # and with nothing to restore at all, the error says so
+        with pytest.raises(ValueError, match="no optimizer state"):
+            tr.restore_flat_state({})
+
+
+# ================================================================== convert
+class TestStateConversion:
+    def test_flat_tree_round_trip_is_bit_exact(self):
+        net = _net()
+        rng = np.random.RandomState(3)
+        n = np.asarray(net.params()).size
+        hist = rng.rand(n).astype(np.float32)
+        vel = rng.rand(n).astype(np.float32)
+        tree = flat_to_updater_state(hist, vel, np.int32(9), net._params)
+        h2, v2, it2 = updater_state_to_flat(tree, net._params)
+        np.testing.assert_array_equal(hist, h2)
+        np.testing.assert_array_equal(vel, v2)
+        assert int(it2) == 9
+        for st in tree.values():
+            assert int(st.iteration) == 9
+
+    def test_padded_legacy_vectors_are_stripped(self):
+        net = _net()
+        n = np.asarray(net.params()).size
+        padded = np.concatenate([np.arange(n, dtype=np.float32),
+                                 np.zeros(5, np.float32)])
+        tree = flat_to_updater_state(padded, padded, 0, net._params)
+        h2, _, _ = updater_state_to_flat(tree, net._params)
+        np.testing.assert_array_equal(h2, np.arange(n, dtype=np.float32))
+
+    def test_short_vector_rejected_with_architecture_error(self):
+        net = _net()
+        with pytest.raises(ValueError, match="does not match"):
+            flat_to_updater_state(np.zeros(3, np.float32),
+                                  np.zeros(3, np.float32), 0, net._params)
+
+
+class TestValidateLike:
+    def test_dtype_mismatch_names_the_leaf(self):
+        from deeplearning4j_tpu.checkpoint import validate_like
+
+        ref = {"0": {"W": np.zeros((2, 3), np.float32)}}
+        got = {"0": {"W": np.zeros((2, 3), np.float16)}}
+        with pytest.raises(ValueError, match="0/W.*float16"):
+            validate_like(got, ref)
+
+    def test_inspect_scalars_never_touch_shards(self, tmp_path):
+        """tree_scalars decodes cursor/metadata from the manifest alone
+        — prove it by deleting every shard file first."""
+        from deeplearning4j_tpu.checkpoint import tree_scalars
+
+        root = str(tmp_path)
+        path = write_checkpoint(root, 4, snapshot_tree(_payload()))
+        for f in os.listdir(path):
+            if f.endswith(".npy"):
+                os.remove(os.path.join(path, f))
+        scalars = tree_scalars(read_manifest(root))
+        assert scalars["cursor"] == 7
+        assert scalars["mixed"] == (1, [2.5, "tag"], {"k": True})
+        assert scalars["params"]["0"]["W"] is None  # arrays elided

@@ -95,3 +95,56 @@ def test_predict_with_labelled_csv(tmp_path, iris_csv, conf_json, capsys):
     assert main(["predict", "-i", iris_csv, "-m", conf_json,
                  "-o", out_path]) == 2
     assert "label-columns" in capsys.readouterr().err
+
+
+def test_train_with_checkpoint_dir_and_inspect(tmp_path, iris_csv,
+                                               conf_json, capsys):
+    """--checkpoint-dir writes sharded async autosaves during the fit;
+    `checkpoint inspect` prints the manifest; `-m <dir>` loads the
+    latest committed step for test/predict/serve."""
+    from deeplearning4j_tpu.checkpoint import list_steps
+
+    ckpt = str(tmp_path / "model.ckpt")
+    ckdir = str(tmp_path / "autosaves")
+    assert main(["train", "-i", iris_csv, "-m", conf_json, "-o", ckpt,
+                 "--epochs", "3", "--checkpoint-dir", ckdir]) == 0
+    capsys.readouterr()
+    # arrays-path fit ticks per epoch: 3 committed autosaves
+    assert list_steps(ckdir) == [1, 2, 3]
+
+    # inspect: human output carries the manifest summary + leaf table
+    assert main(["checkpoint", "inspect", ckdir]) == 0
+    out = capsys.readouterr().out
+    assert '"step": 3' in out and "params__0__W" not in out
+    assert "params/0/W" in out
+
+    # machine output round-trips as one JSON object with the leaf table
+    assert main(["checkpoint", "inspect", ckdir, "--json",
+                 "--step", "2"]) == 0
+    summary = json.loads(capsys.readouterr().out.strip())
+    assert summary["step"] == 2 and summary["steps"] == [1, 2, 3]
+    leaves = {row["leaf"] for row in summary["leaves"]}
+    assert "params/0/W" in leaves
+    assert summary["total_bytes"] > 0
+
+    # the checkpoint DIRECTORY is a valid -m for test (latest step)
+    assert main(["test", "-i", iris_csv, "-m", ckdir]) == 0
+    metrics = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+
+
+def test_checkpoint_inspect_missing_dir_errors(tmp_path, capsys):
+    assert main(["checkpoint", "inspect",
+                 str(tmp_path / "nothing")]) == 2
+    assert "no committed" in capsys.readouterr().err
+
+
+def test_checkpoint_every_without_dir_refuses(tmp_path, iris_csv,
+                                              conf_json, capsys):
+    """--checkpoint-every with nowhere to put autosaves must refuse
+    loudly, not run a fit the user believes is checkpointed."""
+    assert main(["train", "-i", iris_csv, "-m", conf_json,
+                 "-o", str(tmp_path / "m.ckpt"),
+                 "--checkpoint-every", "2"]) == 2
+    assert "--checkpoint-dir" in capsys.readouterr().err

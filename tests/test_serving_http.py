@@ -149,6 +149,138 @@ class TestHTTPRoundTrip:
             assert e.value.code == 404
 
 
+class TestHotReload:
+    """ISSUE satellite: POST /reload hot-swaps replica weights from a
+    checkpoint path without dropping in-flight requests."""
+
+    def _checkpoints(self, tmp_path):
+        """Two nets with the same architecture but different weights,
+        each checkpointed: (net_a, net_b, sharded_dir_b, npz_path_b)."""
+        from deeplearning4j_tpu.checkpoint import ShardedModelSaver
+        from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
+
+        net_a, net_b = _net(), _net()
+        x, y = (np.random.RandomState(1).rand(48, 4).astype(np.float32),
+                np.eye(3, dtype=np.float32)[
+                    np.random.RandomState(2).randint(0, 3, 48)])
+        net_b.fit(x, y, epochs=3)  # diverge the weights
+        sharded = str(tmp_path / "sharded")
+        with ShardedModelSaver(sharded, sync=True) as saver:
+            saver.save(net_b, iterator_position=3)
+        npz = str(tmp_path / "b.ckpt")
+        DefaultModelSaver(npz, keep_old=False).save(net_b)
+        return net_a, net_b, sharded, npz
+
+    def test_reload_swaps_weights_without_dropping_requests(self,
+                                                            tmp_path):
+        import threading
+
+        net_a, net_b, sharded, _ = self._checkpoints(tmp_path)
+        x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+        ref_a = np.asarray(net_a.output(x))
+        ref_b = np.asarray(net_b.output(x))
+        assert not np.allclose(ref_a, ref_b)  # the swap is observable
+
+        with serve_network(net_a, n_replicas=2, max_batch_size=16,
+                           max_delay_ms=1.0, warmup_shape=(4,)) as handle:
+            out = _post(f"{handle.url}/predict", {"inputs": x.tolist()})
+            np.testing.assert_allclose(np.asarray(out["outputs"]), ref_a,
+                                       atol=1e-5)
+
+            # hammer /predict from the side WHILE reloading: every
+            # response must be valid (old or new weights, never an error)
+            stop = threading.Event()
+            failures = []
+
+            def hammer():
+                while not stop.is_set():
+                    try:
+                        r = _post(f"{handle.url}/predict",
+                                  {"inputs": x.tolist()})
+                        got = np.asarray(r["outputs"])
+                        if not (np.allclose(got, ref_a, atol=1e-5)
+                                or np.allclose(got, ref_b, atol=1e-5)):
+                            failures.append("torn outputs")
+                    except Exception as e:  # noqa: BLE001
+                        failures.append(repr(e))
+
+            t = threading.Thread(target=hammer, daemon=True)
+            t.start()
+            try:
+                res = _post(f"{handle.url}/reload", {"path": sharded})
+            finally:
+                stop.set()
+                t.join(timeout=30)
+            assert res["reloaded"] and res["replicas"] == 2
+            assert res["step"] == 3
+            assert failures == []
+
+            # all replicas now serve net_b's weights
+            out2 = _post(f"{handle.url}/predict", {"inputs": x.tolist()})
+            np.testing.assert_allclose(np.asarray(out2["outputs"]), ref_b,
+                                       atol=1e-5)
+            stats = _get(f"{handle.url}/stats")
+            assert stats["last_reload"]["step"] == 3
+
+    def test_reload_accepts_legacy_npz_checkpoints(self, tmp_path):
+        net_a, net_b, _, npz = self._checkpoints(tmp_path)
+        x = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+        ref_b = np.asarray(net_b.output(x))
+        with serve_network(net_a, n_replicas=1,
+                           max_delay_ms=1.0) as handle:
+            _post(f"{handle.url}/reload", {"path": npz})
+            out = _post(f"{handle.url}/predict", {"inputs": x.tolist()})
+            np.testing.assert_allclose(np.asarray(out["outputs"]), ref_b,
+                                       atol=1e-5)
+
+    def test_reload_error_paths(self, tmp_path):
+        net_a, _, sharded, npz = self._checkpoints(tmp_path)
+        with serve_network(net_a, n_replicas=1,
+                           max_delay_ms=1.0) as handle:
+            # missing path key -> 400
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(f"{handle.url}/reload", {})
+            assert e.value.code == 400
+            # nonexistent checkpoint -> 404
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(f"{handle.url}/reload",
+                      {"path": str(tmp_path / "nope")})
+            assert e.value.code == 404
+            # step pin against a single-file npz -> 400, not a silent
+            # load of whatever the file holds
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(f"{handle.url}/reload", {"path": npz, "step": 5})
+            assert e.value.code == 400
+            assert "no steps" in json.loads(e.value.read())["error"]
+            # architecture mismatch -> 400 naming the leaf
+            from deeplearning4j_tpu.checkpoint import ShardedModelSaver
+            other_conf = (NeuralNetConfiguration.builder()
+                          .lr(0.1).n_in(4).activation_function("tanh")
+                          .optimization_algo("iteration_gradient_descent")
+                          .num_iterations(1).use_adagrad(False)
+                          .list(2).hidden_layer_sizes([16])
+                          .override(1, layer="output",
+                                    loss_function="mcxent",
+                                    activation_function="softmax",
+                                    n_out=3)
+                          .pretrain(False).build())
+            wide = MultiLayerNetwork(other_conf)
+            wrong = str(tmp_path / "wrong")
+            with ShardedModelSaver(wrong, sync=True) as saver:
+                saver.save(wide)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(f"{handle.url}/reload", {"path": wrong})
+            assert e.value.code == 400
+            body = json.loads(e.value.read())
+            assert "0/W" in body["error"]  # names the mismatched leaf
+            # the serving weights are untouched after the failed reload
+            x = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+            out = _post(f"{handle.url}/predict", {"inputs": x.tolist()})
+            np.testing.assert_allclose(np.asarray(out["outputs"]),
+                                       np.asarray(net_a.output(x)),
+                                       atol=1e-5)
+
+
 class TestCLIServe:
     def test_serve_smoke(self, tmp_path, capsys):
         from deeplearning4j_tpu.cli import main
