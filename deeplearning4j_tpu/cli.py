@@ -207,6 +207,7 @@ def cmd_serve(args) -> int:
             net, host=args.host, port=args.port, n_replicas=args.replicas,
             max_batch_size=args.max_batch_size,
             max_delay_ms=args.max_delay_ms,
+            slots=args.slots, page_size=args.page_size,
             warmup_shape=(n_in,) if (args.warmup and n_in) else None)
     except BaseException:
         tele.close()
@@ -215,6 +216,8 @@ def cmd_serve(args) -> int:
                       "replicas": len(handle.replicas.engines),
                       "max_batch_size": args.max_batch_size,
                       "max_delay_ms": args.max_delay_ms,
+                      "slots": args.slots,
+                      "page_size": args.page_size,
                       "metrics": handle.url + "/metrics",
                       **tele.announce()}), flush=True)
     if args.smoke:  # start/stop sanity check (tests, deploy probes)
@@ -358,6 +361,12 @@ def build_parser() -> argparse.ArgumentParser:
                          help="micro-batcher coalescing cap / top bucket")
     p_serve.add_argument("--max-delay-ms", type=float, default=2.0,
                          help="micro-batcher coalescing window")
+    p_serve.add_argument("--slots", type=int, default=8,
+                         help="continuous-batching decode slots for "
+                              "/generate (docs/SERVING.md)")
+    p_serve.add_argument("--page-size", type=int, default=16,
+                         help="KV page size in tokens for the paged "
+                              "decode pool")
     p_serve.add_argument("--no-warmup", dest="warmup",
                          action="store_false",
                          help="skip precompiling the bucket programs")

@@ -1,16 +1,25 @@
-"""Inference serving: compiled engines, dynamic micro-batching, KV-cache
-decode, and multi-replica dispatch behind a stdlib HTTP front end.
+"""Inference serving: compiled engines, dynamic micro-batching,
+continuous-batching paged-KV decode, and multi-replica dispatch behind
+a stdlib HTTP front end.
 
 The training side compiles one program per shape bucket and keeps the
 host off the critical path (datasets/device_feed.py); this package
 applies the same discipline to the inference workload: an
 `InferenceEngine` holds one jitted forward per bucket, a `MicroBatcher`
-coalesces concurrent requests into those buckets, `KVCache` makes
-autoregressive decode O(1) per token, and a `ReplicaSet` round-robins
-engines across local devices. See docs/SERVING.md.
+coalesces concurrent `/predict` requests into those buckets, `KVCache`
+makes autoregressive decode O(1) per token, and a `DecodeLoop`
+slot-schedules concurrent generate streams over a paged KV block pool
+(`PagedKVPool`) under ONE compiled decode step — requests join/leave at
+token boundaries, KV memory scales with written tokens, `/generate`
+streams tokens as they emit. A `ReplicaSet` round-robins engines across
+local devices. See docs/SERVING.md.
 """
 
 from deeplearning4j_tpu.serving.batcher import MicroBatcher  # noqa: F401
+from deeplearning4j_tpu.serving.decode_loop import (  # noqa: F401
+    DecodeLoop,
+    GenerationStream,
+)
 from deeplearning4j_tpu.serving.engine import (  # noqa: F401
     EngineStats,
     InferenceEngine,
@@ -22,6 +31,13 @@ from deeplearning4j_tpu.serving.kv_cache import (  # noqa: F401
     init_cache,
     kv_cache_bytes,
     prefill,
+)
+from deeplearning4j_tpu.serving.paged_kv import (  # noqa: F401
+    PagedKVPool,
+    init_paged_pool,
+    paged_decode_step,
+    paged_kv_bytes,
+    paged_prefill,
 )
 from deeplearning4j_tpu.serving.replicas import ReplicaSet  # noqa: F401
 from deeplearning4j_tpu.serving.server import serve_network  # noqa: F401

@@ -5,6 +5,15 @@ an engine (or replica set) and merges whatever arrives within a
 `max_delay_ms` window — up to `max_batch_size` rows — into ONE forward,
 then scatters the output rows back to per-request futures.
 
+Scope: this is the **forward** (`/predict`) path only. Generate traffic
+does NOT coalesce here — a decode is thousands of steps, so batching
+whole requests would couple their lifetimes (one slow request holds the
+batch). `/generate` routes to the slot scheduler instead
+(serving/decode_loop.py), which batches at TOKEN granularity: requests
+join and leave the shared compiled decode step between steps, which is
+why `server.py` hands generate requests to `DecodeLoop.submit` rather
+than `MicroBatcher.submit`.
+
 Contract:
 
 - `submit(x)` is thread-safe and returns a `concurrent.futures.Future`

@@ -1,0 +1,665 @@
+"""Continuous-batching decode loop over a paged KV pool.
+
+The per-request `generate_cached` path compiles one whole-decode scan
+per (B, T0, n_tokens) signature and serves requests one at a time: one
+slow request blocks everything behind it, and every request pays its
+full `n_tokens` even after EOS. `DecodeLoop` replaces that with the
+modern serving shape (the PagedAttention / continuous-batching lineage;
+ROADMAP "Continuous batching + paged KV cache"):
+
+- a fixed pool of **S slots** rides ONE jitted decode step
+  (`paged_kv.paged_decode_step` + on-device argmax feedback). Slot
+  membership is a traced per-slot `stop` bound, never a shape — the
+  step compiles exactly once and requests join/leave without
+  recompiling for the life of the server (`decode_step_programs()`
+  pins this in tests and bench);
+- KV lives in a **paged block pool**: a request holds
+  `ceil(tokens/page_size)` pages, pages return to the free list the
+  moment it completes, and admission is a free-page check — memory
+  scales with tokens actually written, not `max_len × requests`;
+- a **scheduler thread** admits queued prompts into freed slots between
+  steps (bucketed compiled prefill scatters the prompt's K/V into the
+  slot's pages), and emits tokens onto per-request `GenerationStream`s
+  as they come off the chip — the HTTP layer streams them to clients
+  (`server.py /generate`);
+- per-slot **max_tokens / EOS** termination: a finished stream frees
+  its slot and pages immediately; the other slots never notice.
+
+The device carry — last tokens, pool, page table, lengths, stop bounds
+— feeds straight back into the next dispatch; the host re-uploads the
+(S,)/(S,P) control arrays only after a visible event (admission,
+completion, page grant). Steady-state per-token cost is one dispatch
+slice plus the token D2H the streams need anyway. On accelerators the
+pool is donated to the step, so KV updates alias in place; CPU ignores
+donation (gated off to avoid the warning, same as InferenceEngine).
+
+**Decode horizon**: `horizon=K` runs K decode steps inside one compiled
+dispatch (a `lax.scan` feeding each slot's argmax back on device). The
+per-slot `stop` bound makes ragged membership exact — a slot never
+writes past its token budget or its allocated pages, whatever K is —
+and the host trims EOS overshoot (at most K-1 speculative tokens are
+discarded; admission waits at most one chunk). K=1 (the default) is
+pure token-boundary scheduling; dispatch-bound hosts raise it to
+amortize the per-step round trip (`bench.py serve` runs the CPU smoke
+at K=8).
+
+Backpressure: a request is admitted only when the pool can cover its
+prompt plus the first decode write; a mid-flight slot that needs a page
+with the pool empty simply stops advancing (its `stop` clamps to the
+allocated frontier) until a completion frees pages. If every occupied
+slot is stalled and nothing can ever free a page, the stalled streams
+fail with a clear error instead of deadlocking — size the pool with
+`paged_kv_bytes` (docs/SERVING.md).
+
+Telemetry: dl4j_kv_pages_total / dl4j_kv_pages_in_use /
+dl4j_decode_active_slots gauges, dl4j_decode_requests /
+dl4j_decode_tokens_streamed / dl4j_decode_admission_waits counters
+(docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import weakref
+from collections import deque
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.models.transformer import TransformerConfig
+from deeplearning4j_tpu.serving.paged_kv import (init_paged_pool,
+                                                 paged_decode_step,
+                                                 paged_kv_bytes,
+                                                 paged_prefill,
+                                                 pages_for_tokens,
+                                                 pages_per_slot,
+                                                 prompt_buckets)
+from deeplearning4j_tpu.utils.jitcache import jit_cache_size
+
+__all__ = ["GenerationStream", "DecodeLoop"]
+
+_DONE = object()
+_loop_seq = itertools.count()
+
+
+class GenerationStream:
+    """One in-flight generate request: a token queue the scheduler
+    pushes into as the slot emits, plus the blocking `result()` the
+    non-streaming path uses.
+
+    `tokens()` yields generated token ids as they come off the chip
+    (the HTTP streaming response iterates it); `result()` blocks until
+    the stream finishes and returns the full generated list;
+    `full_sequence()` is prompt + generated — the backward-compatible
+    `/generate` response row. `finish_reason` is "eos", "max_tokens" or
+    "error" once done."""
+
+    def __init__(self, prompt: Sequence[int], max_tokens: int,
+                 eos_id: Optional[int]):
+        self.prompt: List[int] = [int(t) for t in prompt]
+        self.max_tokens = int(max_tokens)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        self._generated: List[int] = []
+        self._q: "queue.Queue" = queue.Queue()
+        self._done = threading.Event()
+
+    # ------------------------------------------------- scheduler side
+    def _emit(self, token: int) -> None:
+        self._generated.append(int(token))
+        self._q.put(int(token))
+
+    def _finish(self, reason: str,
+                error: Optional[BaseException] = None) -> None:
+        self.finish_reason = reason
+        self.error = error
+        self._q.put(_DONE)
+        self._done.set()
+
+    # --------------------------------------------------- client side
+    def tokens(self, timeout: Optional[float] = None) -> Iterator[int]:
+        """Yield generated tokens as they are emitted; raises the
+        stream's error (if it failed) after the last delivered token.
+        `timeout` bounds the wait BETWEEN tokens (a stalled scheduler
+        raises TimeoutError, matching result())."""
+        while True:
+            try:
+                item = self._q.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"no token emitted within {timeout}s") from None
+            if item is _DONE:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield item
+
+    def __iter__(self) -> Iterator[int]:
+        return self.tokens()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until finished; return the generated token ids (EOS
+        included when it fired)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation still in flight")
+        if self.error is not None:
+            raise self.error
+        return list(self._generated)
+
+    def full_sequence(self, timeout: Optional[float] = None) -> List[int]:
+        return self.prompt + self.result(timeout)
+
+
+class _Slot:
+    __slots__ = ("stream", "pages", "awaiting_first", "emitted",
+                 "stop_len")
+
+    def __init__(self, stream: GenerationStream, pages: List[int],
+                 stop_len: int):
+        self.stream = stream
+        self.pages = pages        # physical page ids, in logical order
+        #: prefill's first token is still ON DEVICE (in a group batch —
+        #: DecodeLoop._deferred); admission never blocks on a D2H
+        self.awaiting_first = True
+        self.emitted = 0          # tokens pushed onto the stream so far
+        self.stop_len = stop_len  # final length: prompt + max_tokens - 1
+
+
+class DecodeLoop:
+    """Owns the paged pool, the page tables, the single compiled decode
+    step, and the scheduler thread. `submit()` is thread-safe and
+    returns a `GenerationStream`."""
+
+    def __init__(self, params, cfg: TransformerConfig, *, slots: int = 8,
+                 page_size: int = 16, n_pages: Optional[int] = None,
+                 horizon: int = 1, start: bool = True,
+                 name: Optional[str] = None):
+        import jax
+        import jax.numpy as jnp
+
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if horizon < 1:
+            raise ValueError(f"horizon must be >= 1, got {horizon}")
+        self.cfg = cfg
+        self.params = params
+        self.slots = int(slots)
+        self.page_size = int(page_size)
+        self.horizon = int(horizon)
+        self._pps = pages_per_slot(cfg, self.page_size)
+        if n_pages is None:
+            # safe default: worst case (every slot at max_len) — callers
+            # chasing HBM set it lower and lean on the backpressure
+            n_pages = self.slots * self._pps
+        self.n_pages = int(n_pages)
+        self._buckets = prompt_buckets(cfg, self.page_size)
+
+        # device state ------------------------------------------------
+        self._pool = init_paged_pool(cfg, self.n_pages, self.page_size)
+        self._trash = self._pool.trash_page
+        self._d_tokens = None       # (S,) int32
+        self._d_table = None        # (S, P) int32
+        self._d_lengths = None      # (S,) int32
+        self._d_stop = None         # (S,) int32
+        # host mirrors (scheduler-thread-owned) -----------------------
+        self._table = np.full((self.slots, self._pps), self._trash,
+                              np.int32)
+        self._lengths = np.zeros((self.slots,), np.int32)
+        self._stop = np.zeros((self.slots,), np.int32)
+        self._pending = np.zeros((self.slots,), np.int32)
+        self._dirty = True          # mirrors changed since last upload
+        self._free: deque = deque(range(self.n_pages))
+        self._slot_state: List[Optional[_Slot]] = [None] * self.slots
+        #: prefill-group first tokens still on device:
+        #: [(device (B,) array, [(row, slot_idx), ...])]
+        self._deferred: List = []
+
+        # compiled programs -------------------------------------------
+        # donation lets XLA update the pool in place on accelerators;
+        # CPU ignores donation with a warning, so gate it off there
+        donate_step = () if jax.default_backend() == "cpu" else (2,)
+        donate_pre = () if jax.default_backend() == "cpu" else (3,)
+        k_steps = self.horizon
+
+        def step_fn(params, tokens, pool, table, lengths, stop):
+            """K chained decode steps in one dispatch. Per-slot
+            activity is `lengths < stop` — a slot out of budget or out
+            of allocated pages stops advancing mid-chunk exactly where
+            it should, so horizon never corrupts state."""
+            def inner(carry, _):
+                tokens, lengths, pool = carry
+                act = lengths < stop
+                logits, pool = paged_decode_step(
+                    params, tokens, pool, table, lengths, act, cfg)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                tokens = jnp.where(act, nxt, tokens)
+                lengths = lengths + act.astype(lengths.dtype)
+                return (tokens, lengths, pool), nxt
+
+            (tokens, lengths, pool), toks = jax.lax.scan(
+                inner, (tokens, lengths, pool), None, length=k_steps)
+            return toks, tokens, lengths, pool
+
+        def prefill_fn(params, tokens, true_len, pool, page_ids):
+            logits, pool = paged_prefill(params, tokens, true_len, pool,
+                                         page_ids, cfg)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), pool
+
+        self._step = jax.jit(step_fn, donate_argnums=donate_step)
+        self._prefill = jax.jit(prefill_fn, donate_argnums=donate_pre)
+
+        # queueing / lifecycle ----------------------------------------
+        self._cond = threading.Condition()
+        self._waiting: deque = deque()  # GenerationStreams not yet admitted
+        self._closed = False
+        self._peak_pages = 0
+        self._thread: Optional[threading.Thread] = None
+
+        # telemetry ----------------------------------------------------
+        reg = telemetry.get_registry()
+        self.label = name if name is not None else f"d{next(_loop_seq)}"
+        lab = {"loop": self.label}
+        self._m_requests = reg.counter(
+            "dl4j_decode_requests",
+            "generate requests submitted to the slot scheduler"
+        ).labels(**lab)
+        self._m_tokens = reg.counter(
+            "dl4j_decode_tokens_streamed",
+            "tokens emitted onto generation streams").labels(**lab)
+        self._m_waits = reg.counter(
+            "dl4j_decode_admission_waits",
+            "scheduler passes where a queued request could not be "
+            "admitted for lack of free pages or slots").labels(**lab)
+        self._m_steps = reg.counter(
+            "dl4j_decode_steps",
+            "compiled decode dispatches run (each covers `horizon` "
+            "token steps)").labels(**lab)
+        reg.gauge(
+            "dl4j_kv_pages_total",
+            "usable KV pages in the block pool").labels(**lab).set(
+                self.n_pages)
+        ref = weakref.ref(self)
+        reg.gauge(
+            "dl4j_kv_pages_in_use",
+            "KV pages currently held by in-flight requests"
+        ).labels(**lab).set_function(
+            lambda: (lambda o: o.pages_in_use if o else 0)(ref()))
+        reg.gauge(
+            "dl4j_decode_active_slots",
+            "slots holding an in-flight request").labels(
+                **lab).set_function(
+            lambda: (lambda o: o.occupied_slots if o else 0)(ref()))
+
+        if start:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name=f"decode-loop-{self.label}")
+            self._thread.start()
+
+    # ----------------------------------------------------- public API
+    def validate(self, prompt, max_tokens: int) -> np.ndarray:
+        """Check one request without enqueueing it (raises ValueError);
+        returns the normalized 1-D prompt. Callers submitting several
+        rows as one unit (the HTTP /generate handler) validate ALL rows
+        first, so a malformed row never orphans its row-mates'
+        already-running streams."""
+        prompt = np.asarray(prompt).ravel().astype(np.int64)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_tokens < 1:
+            raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
+        if prompt.size + max_tokens > self.cfg.max_len:
+            raise ValueError(
+                f"generation would exceed max_len ({prompt.size} prompt "
+                f"+ {max_tokens} new > {self.cfg.max_len})")
+        need = pages_for_tokens(int(prompt.size) + 1, self.page_size)
+        if need > self.n_pages:
+            raise ValueError(
+                f"prompt needs {need} pages but the pool only has "
+                f"{self.n_pages}")
+        return prompt
+
+    def submit(self, prompt, max_tokens: int,
+               eos_id: Optional[int] = None) -> GenerationStream:
+        """Queue one prompt (1-D int sequence). The stream's first token
+        arrives after admission + prefill; termination on EOS (when
+        given), `max_tokens`, or the model window."""
+        prompt = self.validate(prompt, max_tokens)
+        stream = GenerationStream(prompt, max_tokens, eos_id)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("decode loop is closed")
+            self._m_requests.inc()
+            self._waiting.append(stream)
+            self._cond.notify_all()
+        return stream
+
+    def generate(self, prompt, max_tokens: int,
+                 eos_id: Optional[int] = None,
+                 timeout: Optional[float] = 120.0) -> List[int]:
+        """Blocking convenience: submit + wait, returns prompt+generated
+        (the `/generate` non-streaming row shape)."""
+        return self.submit(prompt, max_tokens, eos_id).full_sequence(timeout)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.n_pages - len(self._free)
+
+    @property
+    def occupied_slots(self) -> int:
+        return sum(1 for s in self._slot_state if s is not None)
+
+    def kv_pool_bytes(self) -> int:
+        return paged_kv_bytes(self.cfg, self.n_pages, self.page_size)
+
+    def decode_step_programs(self) -> int:
+        """Compiled-program count for the shared decode step — the
+        continuous-batching recompile guard: exactly 1 after warmup, no
+        matter how requests join/leave. -1 when the private jax counter
+        API drifted."""
+        return jit_cache_size(self._step)
+
+    def prefill_programs(self) -> int:
+        """Compiled prefill programs — bounded by the prompt bucket
+        ladder (one per bucket hit)."""
+        return jit_cache_size(self._prefill)
+
+    def snapshot(self) -> dict:
+        with self._cond:
+            return {
+                "slots": self.slots,
+                "occupied_slots": self.occupied_slots,
+                "queued": len(self._waiting),
+                "page_size": self.page_size,
+                "horizon": self.horizon,
+                "pages_total": self.n_pages,
+                "pages_in_use": self.pages_in_use,
+                "peak_pages_in_use": self._peak_pages,
+                "pool_bytes": self.kv_pool_bytes(),
+                "requests": int(self._m_requests.value),
+                "tokens_streamed": int(self._m_tokens.value),
+                "admission_waits": int(self._m_waits.value),
+                "dispatches": int(self._m_steps.value),
+                "decode_step_programs": self.decode_step_programs(),
+                "prefill_programs": self.prefill_programs(),
+            }
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop accepting new requests, drain everything queued and in
+        flight, stop the scheduler thread."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def __enter__(self) -> "DecodeLoop":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------ scheduler
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while (not self._closed and not self._waiting
+                       and self.occupied_slots == 0):
+                    self._cond.wait(timeout=0.1)
+                if (self._closed and not self._waiting
+                        and self.occupied_slots == 0):
+                    return
+            try:
+                self.tick()
+            except Exception as e:  # pragma: no cover — defensive: a
+                # scheduler crash must fail the in-flight streams loudly
+                # instead of hanging every waiting client
+                self._fail_all(e)
+                return
+
+    def _fail_all(self, exc: BaseException) -> None:
+        with self._cond:
+            self._deferred = []
+            for i, slot in enumerate(self._slot_state):
+                if slot is not None:
+                    self._free.extend(slot.pages)
+                    slot.stream._finish("error", exc)
+                    self._slot_state[i] = None
+            while self._waiting:
+                self._waiting.popleft()._finish("error", exc)
+
+    def tick(self) -> bool:
+        """One scheduler pass: admit what fits, grant boundary pages,
+        run one compiled dispatch if any slot can advance, emit tokens,
+        retire finished slots. Returns True if a dispatch ran. Public so
+        tests (and `start=False` callers) can drive the loop
+        deterministically."""
+        self._admit()
+        ran = self._dispatch()
+        if not ran:
+            # no chunk ran (e.g. every admitted request has
+            # max_tokens=1): deferred prefill tokens still must reach
+            # their streams
+            self._flush_first_tokens()
+        if not ran:
+            # nothing advanced: either idle, or every occupied slot is
+            # starved of pages that can never come — fail those rather
+            # than spin forever
+            with self._cond:
+                stuck = (self.occupied_slots > 0 and not self._free
+                         and all(s is None
+                                 or self._stop[i] <= self._lengths[i]
+                                 for i, s in enumerate(self._slot_state)))
+            if stuck:
+                self._fail_all(RuntimeError(
+                    "KV page pool exhausted with every slot stalled — "
+                    "no completion can free a page; size the pool with "
+                    "paged_kv_bytes (docs/SERVING.md)"))
+        return ran
+
+    def run_until_idle(self, max_ticks: int = 100_000) -> None:
+        """Drive the loop inline until nothing is queued or in flight
+        (manual mode / tests)."""
+        for _ in range(max_ticks):
+            with self._cond:
+                if not self._waiting and self.occupied_slots == 0:
+                    return
+            self.tick()
+        raise RuntimeError("decode loop did not drain")
+
+    # ---- admission
+    def _admit(self) -> None:
+        import jax.numpy as jnp
+
+        # claim everything that fits in one lock pass
+        admitted = []  # (slot_idx, stream, pages, plen)
+        with self._cond:
+            used = {i for i, s in enumerate(self._slot_state)
+                    if s is not None}
+            while self._waiting:
+                stream = self._waiting[0]
+                plen = len(stream.prompt)
+                # prompt pages + room for the first decode write: the
+                # admission check that replaces the contiguous path's
+                # whole-max_len reservation
+                need = pages_for_tokens(plen + 1, self.page_size)
+                idx = next((i for i in range(self.slots)
+                            if i not in used), None)
+                if idx is None or len(self._free) < need:
+                    self._m_waits.inc()
+                    break
+                self._waiting.popleft()
+                used.add(idx)
+                prompt_pages = pages_for_tokens(plen, self.page_size)
+                pages = [self._free.popleft()
+                         for _ in range(prompt_pages)]
+                admitted.append((idx, stream, pages, plen))
+            if admitted:
+                self._peak_pages = max(self._peak_pages,
+                                       self.pages_in_use)
+        if not admitted:
+            return
+        # one compiled prefill per (prompt-bucket, batch-bucket) group:
+        # an admission burst costs O(groups) dispatches, not O(streams).
+        # The prefill is dispatched but NOT synced — first tokens stay
+        # on device until the next flush, so back-to-back groups queue
+        # without a host round trip between them.
+        by_bucket: dict = {}
+        for item in admitted:
+            tb = next(b for b in self._buckets if b >= item[3])
+            by_bucket.setdefault(tb, []).append(item)
+        for tb, group in by_bucket.items():
+            bb = 1
+            while bb < len(group):
+                bb *= 2
+            n_pids = tb // self.page_size
+            padded = np.zeros((bb, tb), np.int32)
+            lens = np.ones((bb,), np.int32)  # pad rows: true_len 1
+            pids = np.full((bb, n_pids), self._trash, np.int32)
+            for row, (idx, stream, pages, plen) in enumerate(group):
+                padded[row, :plen] = stream.prompt
+                lens[row] = plen
+                pids[row, :len(pages)] = pages
+            first, self._pool = self._prefill(
+                self.params, jnp.asarray(padded), jnp.asarray(lens),
+                self._pool, jnp.asarray(pids))
+            members = []
+            for row, (idx, stream, pages, plen) in enumerate(group):
+                slot = _Slot(stream, pages,
+                             stop_len=plen + stream.max_tokens - 1)
+                members.append((row, idx))
+                with self._cond:
+                    self._slot_state[idx] = slot
+                    self._table[idx, :len(pages)] = pages
+                    self._lengths[idx] = plen
+                    self._pending[idx] = 0  # real value still on device
+                    self._stop[idx] = 0  # set by _grant_pages
+                    self._dirty = True
+            self._deferred.append((first, members))
+
+    # ---- page granting
+    def _grant_pages(self) -> None:
+        """Before a dispatch: give every occupied slot pages covering
+        its next `horizon` positions (capped at its token budget) and
+        set its device `stop` bound to the granted frontier — a slot
+        the pool cannot extend simply stops advancing there."""
+        with self._cond:
+            for i, slot in enumerate(self._slot_state):
+                if slot is None:
+                    continue
+                length = int(self._lengths[i])
+                target = min(length + self.horizon, slot.stop_len)
+                want = pages_for_tokens(target, self.page_size)
+                granted = False
+                while len(slot.pages) < want and self._free:
+                    page = self._free.popleft()
+                    self._table[i, len(slot.pages)] = page
+                    slot.pages.append(page)
+                    granted = True
+                if granted:
+                    self._peak_pages = max(self._peak_pages,
+                                           self.pages_in_use)
+                alloc_end = len(slot.pages) * self.page_size
+                stop = min(slot.stop_len, alloc_end)
+                if stop <= length and slot.stop_len > length:
+                    self._m_waits.inc()  # page-starved this pass
+                if stop != self._stop[i]:
+                    self._stop[i] = stop
+                    self._dirty = True
+
+    # ---- one compiled dispatch (horizon token steps)
+    def _dispatch(self) -> bool:
+        import jax.numpy as jnp
+
+        self._grant_pages()
+        with self._cond:
+            runnable = [i for i, s in enumerate(self._slot_state)
+                        if s is not None
+                        and self._stop[i] > self._lengths[i]]
+            if not runnable:
+                return False
+            before = self._lengths.copy()
+            if self._dirty or self._d_tokens is None:
+                self._d_tokens = jnp.asarray(self._pending)
+                self._d_table = jnp.asarray(self._table)
+                self._d_lengths = jnp.asarray(self._lengths)
+                self._d_stop = jnp.asarray(self._stop)
+                self._dirty = False
+            # overlay deferred prefill tokens (still device-resident)
+            # into the feedback array — ONE scatter per prefill group,
+            # no sync
+            for arr, members in self._deferred:
+                rows = jnp.asarray([r for r, _ in members])
+                idxs = jnp.asarray([i for _, i in members])
+                self._d_tokens = self._d_tokens.at[idxs].set(arr[rows])
+        toks, t_out, l_out, self._pool = self._step(
+            self.params, self._d_tokens, self._pool, self._d_table,
+            self._d_lengths, self._d_stop)
+        self._m_steps.inc()
+        # the (K, S) token D2H is the sync the streams need anyway
+        toks = np.asarray(toks)
+        self._d_tokens, self._d_lengths = t_out, l_out
+        self._flush_first_tokens()  # emit firsts BEFORE chunk tokens
+        for i in runnable:
+            slot = self._slot_state[i]
+            if slot is None:  # retired at flush (eos on first token)
+                continue
+            consumed = min(self.horizon, int(self._stop[i] - before[i]))
+            with self._cond:
+                self._lengths[i] = before[i] + consumed
+            for j in range(consumed):
+                tok = int(toks[j, i])
+                self._pending[i] = tok
+                slot.emitted += 1
+                self._emit_and_maybe_finish(i, slot, tok)
+                if self._slot_state[i] is None:
+                    break  # retired: discard speculative overshoot
+        return True
+
+    def _flush_first_tokens(self) -> None:
+        """Read deferred prefill tokens (one D2H per prefill group —
+        the compute is long finished) and emit them."""
+        deferred, self._deferred = self._deferred, []
+        for arr, members in deferred:
+            host = np.asarray(arr)
+            for row, i in members:
+                slot = self._slot_state[i]
+                if slot is None or not slot.awaiting_first:
+                    continue  # failed/cleared meanwhile
+                tok = int(host[row])
+                slot.awaiting_first = False
+                self._pending[i] = tok
+                slot.emitted += 1
+                self._emit_and_maybe_finish(i, slot, tok)
+
+    # ---- emission / retirement
+    def _emit_and_maybe_finish(self, idx: int, slot: _Slot,
+                               token: int) -> None:
+        stream = slot.stream
+        stream._emit(token)
+        self._m_tokens.inc()
+        if (stream.eos_id is not None and token == stream.eos_id):
+            self._retire(idx, slot, "eos")
+        elif slot.emitted >= stream.max_tokens:
+            self._retire(idx, slot, "max_tokens")
+
+    def _retire(self, idx: int, slot: _Slot, reason: str) -> None:
+        with self._cond:
+            self._slot_state[idx] = None
+            self._table[idx, :] = self._trash
+            self._lengths[idx] = 0
+            self._stop[idx] = 0
+            self._pending[idx] = 0
+            self._free.extend(slot.pages)
+            self._dirty = True
+            self._cond.notify_all()  # admissions may proceed
+        slot.stream._finish(reason)

@@ -167,6 +167,10 @@ class InferenceEngine:
         donate = () if jax.default_backend() == "cpu" else (1,)
         self._jit = jax.jit(apply_fn, donate_argnums=donate)
         self._generate_fn = generate_fn
+        #: continuous-batching slot scheduler (transformer engines;
+        #: start_decode_loop) — None until started
+        self.decode_loop = None
+        self._tf_cfg = None
         self.stats = EngineStats()
         from deeplearning4j_tpu.telemetry import device as _tdev
         _tdev.watch_jit_cache("serving_engine", self.program_cache_size)
@@ -180,16 +184,27 @@ class InferenceEngine:
                    net.param_table, **kw)
 
     @classmethod
-    def for_transformer(cls, params, cfg, **kw) -> "InferenceEngine":
+    def for_transformer(cls, params, cfg, *, decode_slots: int = 0,
+                        page_size: int = 16,
+                        kv_pages: Optional[int] = None,
+                        **kw) -> "InferenceEngine":
         """Wrap a transformer LM: apply = full logits (B, T, vocab);
-        `generate()` runs the KV-cached decode loop."""
+        `generate()` runs the per-request KV-cached compiled scan.
+        `decode_slots > 0` additionally starts the continuous-batching
+        `DecodeLoop` (paged KV pool, `generate_stream()`); pass
+        `page_size`/`kv_pages` to size the pool (docs/SERVING.md)."""
         from deeplearning4j_tpu.models.transformer import transformer_logits
         from deeplearning4j_tpu.serving.kv_cache import generate_cached
 
-        return cls(lambda p, tok: transformer_logits(p, tok, cfg), params,
-                   generate_fn=lambda p, prompt, n: generate_cached(
-                       p, prompt, cfg, n),
-                   **kw)
+        eng = cls(lambda p, tok: transformer_logits(p, tok, cfg), params,
+                  generate_fn=lambda p, prompt, n: generate_cached(
+                      p, prompt, cfg, n),
+                  **kw)
+        eng._tf_cfg = cfg
+        if decode_slots:
+            eng.start_decode_loop(slots=decode_slots, page_size=page_size,
+                                  n_pages=kv_pages)
+        return eng
 
     @classmethod
     def for_lstm(cls, layer, params, **kw) -> "InferenceEngine":
@@ -257,6 +272,46 @@ class InferenceEngine:
                           time.perf_counter() - start)
         return out
 
+    # ------------------------------------------- continuous batching
+    def start_decode_loop(self, slots: int = 8, page_size: int = 16,
+                          n_pages: Optional[int] = None,
+                          horizon: int = 1):
+        """Start the continuous-batching slot scheduler
+        (serving/decode_loop.py) for this transformer engine: S slots
+        over a paged KV pool riding ONE compiled decode step. `/generate`
+        traffic routes here instead of the per-request compiled-scan
+        path — requests join/leave at token boundaries and KV memory
+        scales with written tokens."""
+        from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
+
+        if self._tf_cfg is None:
+            raise ValueError(
+                "decode loop needs a transformer engine (construct it "
+                "with InferenceEngine.for_transformer)")
+        if self.decode_loop is not None:
+            raise RuntimeError("decode loop already started")
+        self.decode_loop = DecodeLoop(self._params, self._tf_cfg,
+                                      slots=slots, page_size=page_size,
+                                      n_pages=n_pages, horizon=horizon)
+        return self.decode_loop
+
+    def generate_stream(self, prompt, max_tokens: int,
+                        eos_id: Optional[int] = None):
+        """Submit one prompt (1-D token sequence) to the slot scheduler;
+        returns a `GenerationStream` emitting tokens as they come off
+        the chip, terminated by EOS or `max_tokens`. Requires
+        `start_decode_loop` (or `decode_slots=` at construction)."""
+        if self.decode_loop is None:
+            raise ValueError(
+                "this engine has no decode loop (pass decode_slots= to "
+                "for_transformer or call start_decode_loop)")
+        return self.decode_loop.submit(prompt, max_tokens, eos_id)
+
+    def close(self) -> None:
+        """Drain and stop the decode loop (no-op without one)."""
+        if self.decode_loop is not None:
+            self.decode_loop.close()
+
     # ------------------------------------------------------- hot reload
     def load_params(self, params) -> None:
         """Swap this engine's weights in place — zero-downtime reload.
@@ -281,6 +336,10 @@ class InferenceEngine:
 
             params = jax.tree_util.tree_map(jnp.asarray, params)
         self._params = params  # atomic swap
+        if self.decode_loop is not None:
+            # same single-reference swap: in-flight decode steps keep
+            # the params they closed over, the next step sees new ones
+            self.decode_loop.params = params
 
     # ---------------------------------------------------- observability
     def warmup(self, feature_shape: Sequence[int],
@@ -310,4 +369,6 @@ class InferenceEngine:
         snap["compiled_programs"] = self.program_cache_size()
         if self.device is not None:
             snap["device"] = str(self.device)
+        if self.decode_loop is not None:
+            snap["decode"] = self.decode_loop.snapshot()
         return snap

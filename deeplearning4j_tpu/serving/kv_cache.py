@@ -54,12 +54,28 @@ class KVCache(NamedTuple):
     cursor: jax.Array  # int32 scalar: number of filled positions
 
 
+def _check_cache_args(batch_size: int, length, max_len: int) -> int:
+    """Shared validation: `length=None` means the full window; an
+    EXPLICIT length=0 (or negative) is rejected — the old `length or
+    max_len` idiom silently allocated the full window for it, which is
+    never what a caller asking for a 0-length cache meant."""
+    if batch_size < 1:
+        raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+    if length is None:
+        return max_len
+    if length < 1:
+        raise ValueError(
+            f"length must be >= 1, got {length} (omit it or pass None "
+            f"for the full max_len window)")
+    return length
+
+
 def init_cache(cfg: TransformerConfig, batch_size: int,
-               length: int = 0) -> KVCache:
+               length: int = None) -> KVCache:
     """Empty cache for `batch_size` streams. `length` defaults to
     cfg.max_len — always allocating the full window keeps decode-step
     shapes identical across requests (one program, any prompt)."""
-    length = length or cfg.max_len
+    length = _check_cache_args(batch_size, length, cfg.max_len)
     hd = cfg.d_model // cfg.n_heads
     shape = (batch_size, cfg.n_heads, length, hd)
     layers = tuple({"k": jnp.zeros(shape, cfg.dtype),
@@ -69,9 +85,11 @@ def init_cache(cfg: TransformerConfig, batch_size: int,
 
 
 def kv_cache_bytes(cfg: TransformerConfig, batch_size: int,
-                   length: int = 0) -> int:
-    """HBM the cache pins per batch — the serving memory envelope."""
-    length = length or cfg.max_len
+                   length: int = None) -> int:
+    """HBM the cache pins per batch — the serving memory envelope for
+    the contiguous path (the paged pool's twin is
+    `paged_kv.paged_kv_bytes`, which budgets pages, not requests)."""
+    length = _check_cache_args(batch_size, length, cfg.max_len)
     itemsize = jnp.dtype(cfg.dtype).itemsize
     return 2 * cfg.n_layers * batch_size * length * cfg.d_model * itemsize
 

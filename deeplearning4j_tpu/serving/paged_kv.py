@@ -1,0 +1,249 @@
+"""Paged KV cache: block-pool K/V storage for continuous-batching decode.
+
+The contiguous `KVCache` (kv_cache.py) reserves `(B, H, max_len, hd)`
+per request — HBM for the worst case, not for the tokens actually
+written, and one slow request holds its whole batch's reservation until
+the batch finishes. This module stores KV in a shared **block pool** of
+fixed-size pages (the PagedAttention design carried into the repo's
+portable O(1)-cache decode, PAPERS.md arXiv:2603.09555):
+
+- per layer, one `(n_pages + 1, n_heads, page_size, head_dim)` pool for
+  K and one for V. The LAST page is the **trash page**: masked slots
+  (inactive / paused) direct their writes there so the scatter in the
+  compiled step never needs a data-dependent shape. The host allocator
+  never hands the trash page out.
+- a per-slot **page table** `(S, pages_per_slot)` of pool indices maps a
+  slot's logical positions `[0, max_len)` onto physical pages.
+  Unallocated entries hold the trash index so gathers are always valid
+  (their positions are masked out of attention by the slot's length).
+
+KV memory therefore scales with tokens actually written: a slot holds
+`ceil(tokens / page_size)` pages, pages return to the pool the moment a
+request completes, and admission is a free-page check instead of a
+whole-`max_len` reservation (`serving/decode_loop.py` owns that
+accounting; `paged_kv_bytes` is the envelope).
+
+Shapes in both compiled entry points are fixed for the life of the
+server: `paged_decode_step` is ONE program over S slots (page table,
+lengths and the active mask are traced arrays — requests join and leave
+without recompiling), `paged_prefill` compiles one program per
+prompt-length bucket (buckets are page multiples, `prompt_buckets`).
+
+Parity: positions beyond a slot's length are masked to NEG_INF before
+the softmax, so `exp` underflows to exactly 0 and garbage in unwritten
+page tails contributes exactly 0 — the paged step is the contiguous
+`decode_step` to float tolerance (tests/test_paged_decode.py pins 1e-5
+teacher-forced).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.attention.blockwise import NEG_INF
+from deeplearning4j_tpu.attention.flash_pallas import flash_attention
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   _layer_norm)
+from deeplearning4j_tpu.serving.kv_cache import _ffn, _heads
+
+__all__ = ["PagedKVPool", "init_paged_pool", "paged_kv_bytes",
+           "pages_per_slot", "pages_for_tokens", "prompt_buckets",
+           "paged_prefill", "paged_decode_step"]
+
+
+class PagedKVPool(NamedTuple):
+    """Per-block K/V page pools. `layers`: tuple (one per transformer
+    block) of {"k", "v"} arrays of shape (n_pages + 1, n_heads,
+    page_size, head_dim); index `n_pages` (the last page) is the trash
+    page for masked writes."""
+
+    layers: Tuple[Any, ...]
+
+    @property
+    def page_size(self) -> int:
+        return self.layers[0]["k"].shape[2]
+
+    @property
+    def n_pages(self) -> int:
+        """Usable pages (the trash page is excluded)."""
+        return self.layers[0]["k"].shape[0] - 1
+
+    @property
+    def trash_page(self) -> int:
+        return self.layers[0]["k"].shape[0] - 1
+
+
+def pages_per_slot(cfg: TransformerConfig, page_size: int) -> int:
+    """Page-table width: pages covering the model's full window."""
+    return -(-cfg.max_len // page_size)
+
+
+def pages_for_tokens(n_tokens: int, page_size: int) -> int:
+    """Physical pages holding `n_tokens` written positions."""
+    return -(-n_tokens // page_size)
+
+
+def prompt_buckets(cfg: TransformerConfig, page_size: int
+                   ) -> Tuple[int, ...]:
+    """Prefill prompt-length buckets: page-multiple powers of two up to
+    the full window, so ragged prompts compile a handful of prefill
+    programs, ever (the DeviceFeed ladder idea applied to T)."""
+    top = pages_per_slot(cfg, page_size) * page_size
+    buckets, b = [], page_size
+    while b < top:
+        buckets.append(b)
+        b *= 2
+    buckets.append(top)
+    return tuple(buckets)
+
+
+def init_paged_pool(cfg: TransformerConfig, n_pages: int,
+                    page_size: int) -> PagedKVPool:
+    """Allocate the block pool (`n_pages` usable + 1 trash page per
+    layer). Pool HBM is fixed at construction — per-request cost is
+    page-table bookkeeping, not allocation."""
+    if n_pages < 1:
+        raise ValueError(f"n_pages must be >= 1, got {n_pages}")
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    hd = cfg.d_model // cfg.n_heads
+    shape = (n_pages + 1, cfg.n_heads, page_size, hd)
+    layers = tuple({"k": jnp.zeros(shape, cfg.dtype),
+                    "v": jnp.zeros(shape, cfg.dtype)}
+                   for _ in range(cfg.n_layers))
+    return PagedKVPool(layers)
+
+
+def paged_kv_bytes(cfg: TransformerConfig, n_pages: int,
+                   page_size: int) -> int:
+    """HBM the whole pool pins (including the trash page) — the serving
+    memory envelope. Unlike the contiguous `kv_cache_bytes(cfg, B)` this
+    is independent of concurrency: occupancy (pages in use / n_pages)
+    is the load signal, exported as dl4j_kv_pages_{total,in_use}."""
+    if n_pages < 1:
+        raise ValueError(f"n_pages must be >= 1, got {n_pages}")
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    return (2 * cfg.n_layers * (n_pages + 1) * page_size
+            * cfg.d_model * itemsize)
+
+
+def paged_prefill(params, tokens, true_len, pool: PagedKVPool,
+                  page_ids, cfg: TransformerConfig):
+    """Run a BATCH of padded prompts (B, Tb) through every block in one
+    dispatch, scattering each row's K/V into the pool pages listed in
+    its `page_ids` row (shape (B, Tb/page_size); entries past a row's
+    real pages — and every entry of a padding row — hold the trash
+    index). `true_len` is (B,); returns (logits (B, vocab), each row at
+    its own position `true_len - 1`, updated pool).
+
+    Batching matters: an admission burst (N queued prompts hitting
+    freed slots between decode steps) costs one compiled call instead
+    of N — the scheduler pads B up to a small pow2 ladder so program
+    count stays bounded (DecodeLoop._admit).
+
+    Same math as the contiguous `prefill` — causal flash attention means
+    positions < true_len never see the zero-padding, and the padding's
+    garbage K/V lands either in the real last page's tail (masked out of
+    decode by the slot length) or on the trash page."""
+    b, tb = tokens.shape
+    ps = pool.page_size
+    # the page-multiple bucket can overshoot max_len (e.g. max_len=100,
+    # page_size=16 -> top bucket 112): clamp the position ids so the
+    # overshoot rows (pure padding, causally invisible to real
+    # positions) reuse the last embedding instead of reading OOB
+    pos_ids = jnp.minimum(jnp.arange(tb), cfg.max_len - 1)
+    x = params["embed"][tokens] + params["pos"][pos_ids]
+    flat_ids = page_ids.reshape(-1)                    # (B * Tb/ps,)
+    new_layers = []
+    for p, layer in zip(params["blocks"], pool.layers):
+        h = _layer_norm(p["ln1"], x)
+        q = _heads(h, p["Wq"], cfg)
+        k = _heads(h, p["Wk"], cfg)
+        v = _heads(h, p["Wv"], cfg)
+        att = flash_attention(q, k, v, True, interpret=cfg.interpret)
+        att = att.transpose(0, 2, 1, 3).reshape(b, tb, cfg.d_model)
+        x = x + att @ p["Wo"]
+        x = _ffn(p, x)
+        # (B, H, Tb, hd) -> (B * Tb/ps pages, H, ps, hd) page scatter
+        def pages(arr, like):
+            a = arr.astype(like.dtype)
+            a = a.reshape(b, cfg.n_heads, tb // ps, ps, -1)
+            return a.transpose(0, 2, 1, 3, 4).reshape(
+                b * (tb // ps), cfg.n_heads, ps, -1)
+        new_layers.append({
+            "k": layer["k"].at[flat_ids].set(pages(k, layer["k"])),
+            "v": layer["v"].at[flat_ids].set(pages(v, layer["v"])),
+        })
+    x = _layer_norm(params["ln_f"], x)
+    # gather each row's LAST REAL position before the vocab projection —
+    # (B, d) @ (d, vocab) instead of a (B, Tb, vocab) matmul
+    idx = jnp.broadcast_to((true_len - 1)[:, None, None],
+                           (b, 1, cfg.d_model))
+    last_x = jnp.take_along_axis(x, idx, axis=1)[:, 0, :]
+    return last_x @ params["embed"].T, PagedKVPool(tuple(new_layers))
+
+
+def paged_decode_step(params, tokens, pool: PagedKVPool, page_table,
+                      lengths, active, cfg: TransformerConfig):
+    """One decode step over S slots: embed `tokens` (S,), write each
+    active slot's K/V at its own cursor (`lengths`) through the page
+    table, attend over the slot's gathered pages, return
+    (logits (S, vocab), updated pool).
+
+    Everything ragged is a traced ARRAY, never a shape: page_table
+    (S, P) int32, lengths (S,) int32, active (S,) bool — so requests
+    join and leave at token boundaries under ONE compiled program for
+    the life of the server. Inactive slots write to the trash page and
+    their logits are garbage the host ignores; lengths advance on the
+    host side only for slots that ran."""
+    s = tokens.shape[0]
+    d = cfg.d_model
+    hd = d // cfg.n_heads
+    ps = pool.page_size
+    trash = pool.trash_page
+    n_p = page_table.shape[1]
+    window = n_p * ps
+    pos = lengths                                          # (S,)
+    rows = jnp.arange(s)
+    # physical destination of the incoming token's K/V
+    dest = jnp.where(active, page_table[rows, pos // ps], trash)
+    offset = pos % ps
+    x = (params["embed"][tokens] + params["pos"][pos])[:, None, :]
+    mask = jnp.arange(window)[None, :] <= pos[:, None]     # (S, window)
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    new_layers = []
+    for p, layer in zip(params["blocks"], pool.layers):
+        h = _layer_norm(p["ln1"], x)
+        q = _heads(h, p["Wq"], cfg)                        # (S, H, 1, hd)
+        k_new = _heads(h, p["Wk"], cfg)[:, :, 0, :]        # (S, H, hd)
+        v_new = _heads(h, p["Wv"], cfg)[:, :, 0, :]
+        ks = layer["k"].at[dest, :, offset, :].set(
+            k_new.astype(layer["k"].dtype))
+        vs = layer["v"].at[dest, :, offset, :].set(
+            v_new.astype(layer["v"].dtype))
+        # gather each slot's pages into its logical window:
+        # (S, P, H, ps, hd) -> (S, H, P*ps, hd)
+        kg = ks[page_table].transpose(0, 2, 1, 3, 4).reshape(
+            s, cfg.n_heads, window, hd)
+        vg = vs[page_table].transpose(0, 2, 1, 3, 4).reshape(
+            s, cfg.n_heads, window, hd)
+        # exact masked softmax in f32 (the contiguous decode_step math;
+        # masked lanes underflow to exactly 0, so page-tail garbage
+        # contributes exactly 0)
+        sc = jnp.einsum("shqd,shkd->shqk", q.astype(jnp.float32),
+                        kg.astype(jnp.float32)) * scale
+        sc = jnp.where(mask[:, None, None, :], sc, NEG_INF)
+        w = jax.nn.softmax(sc, axis=-1)
+        att = jnp.einsum("shqk,shkd->shqd", w, vg.astype(jnp.float32))
+        att = att.astype(x.dtype).transpose(0, 2, 1, 3).reshape(s, 1, d)
+        x = x + att @ p["Wo"]
+        x = _ffn(p, x)
+        new_layers.append({"k": ks, "v": vs})
+    x = _layer_norm(params["ln_f"], x)
+    logits = x[:, 0, :] @ params["embed"].T
+    return logits, PagedKVPool(tuple(new_layers))

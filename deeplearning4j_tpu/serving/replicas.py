@@ -29,6 +29,7 @@ class ReplicaSet:
             raise ValueError("ReplicaSet needs at least one engine")
         self.engines: List[InferenceEngine] = list(engines)
         self._rr = itertools.cycle(self.engines)
+        self._gen_rr = 0  # separate cursor for generate_stream dispatch
         self._lock = threading.Lock()
 
     @classmethod
@@ -57,7 +58,25 @@ class ReplicaSet:
         return self._next().infer(x)
 
     def generate(self, prompt, n_tokens: int):
+        """Per-request compiled-scan decode on the next replica (the
+        legacy path; concurrent generate traffic belongs on
+        `generate_stream` — the slot scheduler is its own batcher)."""
         return self._next().generate(prompt, n_tokens)
+
+    def generate_stream(self, prompt, max_tokens: int, eos_id=None):
+        """Submit one prompt to a replica's continuous-batching decode
+        loop (round-robin over the replicas that run one). Each loop
+        slot-schedules its own streams, so this fans concurrent
+        generate traffic across chips without coalescing delays."""
+        with self._lock:
+            loops = [e for e in self.engines if e.decode_loop is not None]
+            if not loops:
+                raise ValueError(
+                    "no replica runs a decode loop (construct engines "
+                    "with decode_slots= or call start_decode_loop)")
+            engine = loops[self._gen_rr % len(loops)]
+            self._gen_rr += 1
+        return engine.generate_stream(prompt, max_tokens, eos_id)
 
     def warmup(self, feature_shape, **kw) -> None:
         for engine in self.engines:

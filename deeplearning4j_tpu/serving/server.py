@@ -8,9 +8,16 @@ thread, port-0 auto-assign, graceful close. Endpoints:
   {"outputs": [[...]...], "classes": [...]} — rows go through the
   shared micro-batcher (coalescing concurrent clients) onto the
   round-robin replica set.
-- ``POST /generate`` {"prompt": [[...tokens]], "n_tokens": N} ->
-  {"tokens": [[...]]} — KV-cached decode (requires a transformer
-  engine; 404 otherwise).
+- ``POST /generate`` {"prompt": [[...tokens]], "max_tokens": N,
+  "eos_id": E?, "stream": bool?} -> {"tokens": [[...]],
+  "finish_reasons": [...]} — continuous-batching decode: each prompt
+  row joins the slot scheduler (serving/decode_loop.py) and terminates
+  independently on EOS or its own max_tokens ("n_tokens" is accepted as
+  a legacy alias; the non-streaming response shape is unchanged).
+  ``"stream": true`` switches the response to chunked transfer with one
+  NDJSON line per emitted token ({"row": r, "token": t}) and a final
+  {"done": true, ...} summary line — clients see tokens as slots emit
+  them. Requires a transformer engine; 404 otherwise.
 - ``POST /reload``   {"path": "<checkpoint dir or .ckpt>", "step": N?}
   — hot-swap every replica's weights from a checkpoint
   (docs/CHECKPOINTS.md) WITHOUT dropping in-flight requests: each
@@ -82,11 +89,13 @@ class ServingHandle:
         return self.http.port
 
     def close(self) -> None:
-        """Stop accepting requests, flush the batcher, release the
-        socket."""
+        """Stop accepting requests, flush the batcher, drain the decode
+        loop, release the socket."""
         self.http.close()
         if self.batcher is not None:
             self.batcher.close()
+        if self.generate_engine is not None:
+            self.generate_engine.close()  # drains the decode loop
 
     def __enter__(self) -> "ServingHandle":
         return self
@@ -124,6 +133,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                   generate_engine: Optional[InferenceEngine] = None,
                   n_replicas: Optional[int] = None,
                   max_batch_size: int = 64, max_delay_ms: float = 2.0,
+                  slots: int = 8, page_size: int = 16,
+                  kv_pages: Optional[int] = None,
                   host: str = "127.0.0.1", port: int = 0,
                   warmup_shape=None) -> ServingHandle:
     """Serve a MultiLayerNetwork (or a prebuilt ReplicaSet) over HTTP.
@@ -132,9 +143,12 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
     local devices (capped by `n_replicas`) with `max_batch_size` as the
     top of each engine's bucket ladder — or pass `replicas=` directly
     for custom engines. `generate_engine` (an
-    InferenceEngine.for_transformer) enables /generate.
-    `warmup_shape` (one example's feature shape) precompiles every
-    bucket before the socket opens.
+    InferenceEngine.for_transformer) enables /generate; its requests
+    ride the continuous-batching decode loop (`slots` concurrent
+    streams over a paged KV pool of `kv_pages` pages of `page_size`
+    tokens — docs/SERVING.md tuning notes). `warmup_shape` (one
+    example's feature shape) precompiles every bucket before the socket
+    opens.
     """
     if replicas is None:
         if net is None:
@@ -143,11 +157,22 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                                           max_batch_size=max_batch_size)
     if warmup_shape is not None:
         replicas.warmup(tuple(warmup_shape))
+    # slots=0 opts out of continuous batching: /generate falls back to
+    # the per-request compiled-scan path (no streaming/EOS)
+    if (generate_engine is not None and slots
+            and generate_engine.decode_loop is None):
+        generate_engine.start_decode_loop(slots=slots, page_size=page_size,
+                                          n_pages=kv_pages)
     batcher = replicas.batcher(max_batch_size=max_batch_size,
                                max_delay_ms=max_delay_ms)
     handle = ServingHandle(replicas, batcher, generate_engine)
 
     class Handler(BaseHTTPRequestHandler):
+        # chunked transfer (the streaming /generate response) needs
+        # HTTP/1.1; every non-streaming reply carries Content-Length so
+        # keep-alive connections frame correctly
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, *args):  # quiet
             pass
 
@@ -163,10 +188,9 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
             self.wfile.write(body)
 
         def _read_json(self) -> dict:
-            length = int(self.headers.get("Content-Length") or 0)
-            if length <= 0:
+            if self._body is None:
                 raise ValueError("missing request body")
-            data = json.loads(self.rfile.read(length))
+            data = json.loads(self._body)
             if not isinstance(data, dict):
                 raise ValueError("request body must be a JSON object")
             return data
@@ -188,6 +212,12 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
         def do_POST(self):
+            # slurp the body up front, before ANY reply: under
+            # HTTP/1.1 keep-alive an unread body would desync the
+            # connection — the leftover bytes parse as the client's
+            # next request line (404-before-read was exactly that bug)
+            length = int(self.headers.get("Content-Length") or 0)
+            self._body = self.rfile.read(length) if length > 0 else None
             try:
                 if self.path.startswith("/predict"):
                     self._predict()
@@ -234,10 +264,111 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                 self._reply(404, {"error": "no generate engine configured"})
                 return
             data = self._read_json()
-            prompt = np.asarray(data["prompt"], np.int64)
-            n_tokens = int(data.get("n_tokens", 16))
-            out = generate_engine.generate(prompt, n_tokens)
-            self._reply(200, {"tokens": out.astype(int).tolist()})
+            raw = data["prompt"]
+            if not isinstance(raw, list) or not raw:
+                raise ValueError("prompt must be a non-empty token list "
+                                 "or list of token lists")
+            if not isinstance(raw[0], list):
+                raw = [raw]  # single flat row
+            # rows may be RAGGED — each slot decodes independently, so
+            # unlike /predict there is no rectangularity requirement
+            prompt = [np.asarray(row, np.int64).ravel() for row in raw]
+            if any(row.size < 1 for row in prompt):
+                raise ValueError("prompt rows must be non-empty")
+            # "max_tokens" is the contract; "n_tokens" stays as the
+            # legacy alias so pre-continuous-batching clients keep
+            # working unchanged
+            max_tokens = int(data.get("max_tokens",
+                                      data.get("n_tokens", 16)))
+            eos_id = data.get("eos_id")
+            eos_id = None if eos_id is None else int(eos_id)
+            streaming = bool(data.get("stream", False))
+            loop = generate_engine.decode_loop
+            if loop is None:
+                # legacy per-request compiled-scan path (no slot
+                # scheduler): fixed n_tokens, no EOS, no streaming
+                if eos_id is not None or streaming:
+                    raise ValueError(
+                        "eos_id/stream need the continuous-batching "
+                        "decode loop (serve with slots >= 1)")
+                out = generate_engine.generate(np.asarray(prompt),
+                                               max_tokens)
+                self._reply(200, {"tokens": out.astype(int).tolist()})
+                return
+            # validate EVERY row before submitting any: a malformed row
+            # must 400 the request without orphaning its row-mates'
+            # streams in running slots
+            for row in prompt:
+                loop.validate(row, max_tokens)
+            streams = [loop.submit(row, max_tokens, eos_id)
+                       for row in prompt]
+            if streaming:
+                self._stream_tokens(streams)
+                return
+            rows = [s.full_sequence(_RESULT_TIMEOUT_S) for s in streams]
+            self._reply(200, {
+                "tokens": rows,
+                "finish_reasons": [s.finish_reason for s in streams],
+            })
+
+        def _stream_tokens(self, streams):
+            """Chunked NDJSON: one line per emitted token, as the slots
+            emit them, then a final summary line. The client sees
+            first-token latency, not last-token latency."""
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(obj) -> None:
+                body = (json.dumps(obj) + "\n").encode()
+                self.wfile.write(f"{len(body):x}\r\n".encode()
+                                 + body + b"\r\n")
+                self.wfile.flush()
+
+            try:
+                self._relay_streams(streams, chunk)
+            except Exception as e:  # headers are gone — report in-band
+                chunk({"error": f"{type(e).__name__}: {e}"})
+            self.wfile.write(b"0\r\n\r\n")
+            self.close_connection = True
+
+        def _relay_streams(self, streams, chunk) -> None:
+            if len(streams) == 1:  # common case: emit inline
+                for tok in streams[0].tokens(timeout=_RESULT_TIMEOUT_S):
+                    chunk({"row": 0, "token": int(tok)})
+            else:  # merge rows as they emit, one relay thread per slot
+                import queue as _queue
+                import threading as _threading
+
+                merged: "_queue.Queue" = _queue.Queue()
+
+                def relay(r, s):
+                    try:
+                        for tok in s.tokens(timeout=_RESULT_TIMEOUT_S):
+                            merged.put((r, int(tok)))
+                    except Exception:
+                        pass  # surfaced via finish_reason below
+                    finally:
+                        merged.put((r, None))
+
+                workers = [_threading.Thread(target=relay, args=(r, s),
+                                             daemon=True)
+                           for r, s in enumerate(streams)]
+                for w in workers:
+                    w.start()
+                live = len(streams)
+                while live:
+                    r, tok = merged.get()
+                    if tok is None:
+                        live -= 1
+                    else:
+                        chunk({"row": r, "token": tok})
+            chunk({"done": True,
+                   "tokens": [s.prompt + s.result(_RESULT_TIMEOUT_S)
+                              if s.error is None else None
+                              for s in streams],
+                   "finish_reasons": [s.finish_reason for s in streams]})
 
     handle.http = start_http_server(Handler, host=host, port=port)
     return handle

@@ -116,10 +116,14 @@ class TestHTTPRoundTrip:
             s.bind(("127.0.0.1", handle.port))
 
     def test_generate_endpoint(self):
+        """Backward-compat: the legacy n_tokens request shape returns
+        the same {"tokens": [[prompt+generated]]} rows — now served by
+        the continuous-batching slot scheduler."""
         params = init_transformer_params(jax.random.PRNGKey(0), CFG)
         gen = InferenceEngine.for_transformer(params, CFG)
         with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
-                           generate_engine=gen) as handle:
+                           generate_engine=gen, slots=4,
+                           page_size=8) as handle:
             prompt = [[1, 2, 3, 4]]
             out = _post(f"{handle.url}/generate",
                         {"prompt": prompt, "n_tokens": 5})
@@ -127,6 +131,178 @@ class TestHTTPRoundTrip:
             assert toks.shape == (1, 9)
             assert (toks[:, :4] == np.asarray(prompt)).all()
             assert ((0 <= toks) & (toks < CFG.vocab_size)).all()
+            assert out["finish_reasons"] == ["max_tokens"]
+
+    def test_generate_eos_and_per_request_max_tokens(self):
+        """ISSUE satellite: per-request max_tokens + EOS-token early
+        termination on /generate (ragged rows in one request)."""
+        from deeplearning4j_tpu.serving.kv_cache import generate_cached
+        import jax.numpy as jnp
+
+        params = init_transformer_params(jax.random.PRNGKey(0), CFG)
+        gen = InferenceEngine.for_transformer(params, CFG)
+        prompt = [1, 2, 3, 4]
+        ref = np.asarray(generate_cached(
+            params, jnp.asarray([prompt], jnp.int32), CFG, 12))[0, 4:]
+        eos = int(ref[3])
+        first = int(np.argmax(ref == eos))
+        with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           generate_engine=gen, slots=4,
+                           page_size=8) as handle:
+            out = _post(f"{handle.url}/generate",
+                        {"prompt": [prompt, [5, 6, 7]],
+                         "max_tokens": 12, "eos_id": eos})
+            # row 0 stopped at ITS eos; row 1 ran its own course
+            assert out["tokens"][0] == prompt + ref[:first + 1].tolist()
+            assert out["finish_reasons"][0] == "eos"
+            assert out["finish_reasons"][1] in ("eos", "max_tokens")
+
+    def test_generate_streaming_chunked(self):
+        """ISSUE tentpole: streaming /generate — chunked transfer, one
+        NDJSON line per token as slots emit, final summary line."""
+        params = init_transformer_params(jax.random.PRNGKey(0), CFG)
+        gen = InferenceEngine.for_transformer(params, CFG)
+        with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           generate_engine=gen, slots=4,
+                           page_size=8) as handle:
+            req = urllib.request.Request(
+                f"{handle.url}/generate",
+                data=json.dumps({"prompt": [[1, 2, 3, 4], [5, 6, 7]],
+                                 "max_tokens": 6,
+                                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert r.headers["Content-Type"].startswith(
+                    "application/x-ndjson")
+                # tokens arrive line-by-line BEFORE the body ends
+                events = []
+                while True:
+                    line = r.readline()
+                    if not line:
+                        break
+                    events.append(json.loads(line))
+            token_events = [e for e in events if "token" in e]
+            final = events[-1]
+            assert final["done"] is True
+            assert len(token_events) == 12  # 6 per row
+            # per-row order of streamed tokens == final row content
+            for row in (0, 1):
+                streamed = [e["token"] for e in token_events
+                            if e["row"] == row]
+                plen = len(final["tokens"][row]) - 6
+                assert final["tokens"][row][plen:] == streamed
+            # non-streaming twin returns the same rows (same greedy
+            # decode through the same slot scheduler)
+            out = _post(f"{handle.url}/generate",
+                        {"prompt": [[1, 2, 3, 4], [5, 6, 7]],
+                         "max_tokens": 6})
+            assert out["tokens"] == final["tokens"]
+
+    def test_decode_loop_metrics_e2e(self):
+        """ISSUE satellite: dl4j_kv_pages_* / dl4j_decode_active_slots /
+        streamed-token counters appear on a live /metrics scrape after
+        /generate traffic."""
+        params = init_transformer_params(jax.random.PRNGKey(0), CFG)
+        gen = InferenceEngine.for_transformer(params, CFG)
+        with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           generate_engine=gen, slots=4,
+                           page_size=8) as handle:
+            _post(f"{handle.url}/generate",
+                  {"prompt": [[1, 2, 3, 4]], "max_tokens": 5})
+            with urllib.request.urlopen(f"{handle.url}/metrics",
+                                        timeout=30) as r:
+                text = r.read().decode()
+            for series in (
+                    "dl4j_kv_pages_total",
+                    "dl4j_kv_pages_in_use",
+                    "dl4j_decode_active_slots",
+                    "dl4j_decode_tokens_streamed_total",
+                    "dl4j_decode_requests_total",
+            ):
+                assert series in text, f"{series} missing from /metrics"
+            # the pool gauge reports this loop's configured size and
+            # the request actually streamed its tokens
+            label = gen.decode_loop.label
+            assert (f'dl4j_kv_pages_total{{loop="{label}"}} '
+                    f'{gen.decode_loop.n_pages}') in text
+            streamed = [ln for ln in text.splitlines()
+                        if ln.startswith("dl4j_decode_tokens_streamed")
+                        and f'loop="{label}"' in ln]
+            assert streamed and float(streamed[0].split()[-1]) >= 5
+            # /stats carries the decode-loop occupancy surface
+            stats = _get(f"{handle.url}/stats")
+            dec = stats["generate"]["decode"]
+            assert dec["pages_total"] == gen.decode_loop.n_pages
+            assert dec["pages_in_use"] == 0  # request finished
+            assert dec["decode_step_programs"] == 1
+
+    def test_keepalive_connection_survives_early_reply_paths(self):
+        """HTTP/1.1 keep-alive: a reply sent before the POST body was
+        parsed (404 routes) must still consume the body, or the
+        leftover bytes desync the connection for the next request."""
+        import http.client
+
+        with serve_network(_net(), n_replicas=1,
+                           max_delay_ms=1.0) as handle:
+            conn = http.client.HTTPConnection("127.0.0.1", handle.port,
+                                              timeout=30)
+            try:
+                body = json.dumps({"prompt": [[1, 2]], "n_tokens": 2})
+                # no generate engine -> 404 BEFORE the body is parsed
+                conn.request("POST", "/generate", body=body,
+                             headers={"Content-Type": "application/json"})
+                assert conn.getresponse().read() is not None
+                # unknown route with a body -> 404, body still drained
+                conn.request("POST", "/nowhere", body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 404
+                resp.read()  # client must drain before reusing the conn
+                # the SAME connection must still serve a real request
+                x = np.random.RandomState(0).rand(2, 4)
+                conn.request("POST", "/predict",
+                             body=json.dumps({"inputs": x.tolist()}),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 200
+                assert np.asarray(
+                    json.loads(resp.read())["outputs"]).shape == (2, 3)
+            finally:
+                conn.close()
+
+    def test_generate_slots_zero_selects_legacy_path(self):
+        """slots=0 opts out of continuous batching: /generate serves
+        the per-request compiled scan; stream/eos_id are rejected."""
+        params = init_transformer_params(jax.random.PRNGKey(0), CFG)
+        gen = InferenceEngine.for_transformer(params, CFG)
+        with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           generate_engine=gen, slots=0) as handle:
+            assert gen.decode_loop is None
+            out = _post(f"{handle.url}/generate",
+                        {"prompt": [[1, 2, 3, 4]], "n_tokens": 5})
+            assert len(out["tokens"][0]) == 9
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(f"{handle.url}/generate",
+                      {"prompt": [[1, 2]], "max_tokens": 2,
+                       "stream": True})
+            assert e.value.code == 400
+
+    def test_generate_bad_row_does_not_orphan_row_mates(self):
+        """All rows validate before any submits: a malformed row 400s
+        the request and leaves no stream running in a slot."""
+        params = init_transformer_params(jax.random.PRNGKey(0), CFG)
+        gen = InferenceEngine.for_transformer(params, CFG)
+        with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           generate_engine=gen, slots=2,
+                           page_size=8) as handle:
+            overlong = list(range(CFG.max_len - 2))  # + max_tokens > max_len
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(f"{handle.url}/generate",
+                      {"prompt": [[1, 2, 3], overlong], "max_tokens": 8})
+            assert e.value.code == 400
+            snap = gen.decode_loop.snapshot()
+            assert snap["occupied_slots"] == 0 and snap["queued"] == 0
+            assert snap["requests"] == 0  # nothing was submitted
 
     def test_error_paths(self):
         with serve_network(_net(), n_replicas=1,
