@@ -644,6 +644,179 @@ def bench_serve():
             "window_s": round(win_s, 3)}
 
 
+def bench_fleet():
+    """Fleet config (docs/FLEET.md): (a) scaling curve — aggregate
+    /predict rows/sec and client-side p99 through the router over 1 ->
+    2 -> 4 local replica PROCESSES (each a spawned `cli serve`; on the
+    1-core CPU smoke the curve is flat by construction — the record is
+    the router overhead and the harness, the TPU lane is where the
+    fan-out pays); (b) availability drill: kill one of two replicas
+    mid-hammer — the gate is ZERO client errors (idempotent retries on
+    the surviving replica) and bounded p99 degradation."""
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
+    from deeplearning4j_tpu.serving.fleet import Fleet, ReplicaSpawner
+    from deeplearning4j_tpu.serving.router import serve_fleet
+
+    fast = _fast()
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(16).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([32])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=4)
+            .pretrain(False).build())
+    work = tempfile.mkdtemp(prefix="dl4j_bench_fleet_")
+    ckpt = os.path.join(work, "fleet.ckpt")
+    DefaultModelSaver(ckpt, keep_old=False).save(MultiLayerNetwork(conf))
+    spawner = ReplicaSpawner(ckpt, serve_args=["--max-delay-ms", "1"])
+
+    rows = 4
+    body = _json.dumps(
+        {"inputs": np.random.RandomState(0).rand(rows, 16).tolist()}
+    ).encode()
+
+    def hammer(url, n_threads, per_thread):
+        """Concurrent client load; returns (latencies_s, errors)."""
+        lats, errors = [], []
+        lock = threading.Lock()
+
+        def worker():
+            for _ in range(per_thread):
+                t0 = time.perf_counter()
+                try:
+                    req = urllib.request.Request(
+                        url + "/predict", data=body,
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=60) as r:
+                        r.read()
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        lats.append(dt)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(repr(e))
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(n_threads)]
+        start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return lats, errors, time.perf_counter() - start
+
+    def p99(lats):
+        return (sorted(lats)[max(0, int(len(lats) * 0.99) - 1)]
+                if lats else None)
+
+    n_threads = 4
+    per_thread = 16 if fast else 64
+    scaling = {}
+    drill = None
+    try:
+        for n in (1, 2, 4):
+            fleet = Fleet(spawner=spawner, heartbeat_interval=0.2,
+                          heartbeat_timeout=2.0)
+            router = None
+            try:
+                fleet.spawn(n)
+                fleet.wait_ready(n, timeout=240)
+                router = serve_fleet(fleet)
+                hammer(router.url, n_threads, 4)  # warm every replica
+                lats, errors, wall = hammer(router.url, n_threads,
+                                            per_thread)
+                sp99 = p99(lats)
+                scaling[str(n)] = {
+                    "rows_per_sec": round(len(lats) * rows / wall, 2),
+                    "p99_ms": round(sp99 * 1e3, 2) if sp99 else None,
+                    "requests": len(lats),
+                    "errors": len(errors),
+                }
+                if n == 2:
+                    # ---- availability drill on this rung: kill one
+                    # replica under load, count client-visible errors
+                    calm_p99 = p99(lats)
+                    victim = next(iter(fleet._replicas.values()))
+                    stop = threading.Event()
+                    drill_lats, drill_errors = [], []
+                    dlock = threading.Lock()
+
+                    def drill_worker():
+                        while not stop.is_set():
+                            t0 = time.perf_counter()
+                            try:
+                                req = urllib.request.Request(
+                                    router.url + "/predict", data=body,
+                                    headers={"Content-Type":
+                                             "application/json"})
+                                with urllib.request.urlopen(
+                                        req, timeout=60) as r:
+                                    r.read()
+                                with dlock:
+                                    drill_lats.append(
+                                        time.perf_counter() - t0)
+                            except Exception as e:  # noqa: BLE001
+                                with dlock:
+                                    drill_errors.append(repr(e))
+
+                    workers = [threading.Thread(target=drill_worker,
+                                                daemon=True)
+                               for _ in range(n_threads)]
+                    for t in workers:
+                        t.start()
+                    time.sleep(0.4)
+                    victim.proc.kill()
+                    killed_at = time.monotonic()
+                    evicted_in = None
+                    while time.monotonic() - killed_at < 10.0:
+                        if victim.state == "evicted":
+                            evicted_in = time.monotonic() - killed_at
+                            break
+                        time.sleep(0.02)
+                    time.sleep(0.8)  # keep hammering the survivor
+                    stop.set()
+                    for t in workers:
+                        t.join(timeout=60)
+                    dp99 = p99(drill_lats)
+                    bound = max(20 * calm_p99, 5.0)
+                    snap = fleet.snapshot()
+                    drill = {
+                        "errors": len(drill_errors),
+                        "requests": len(drill_lats),
+                        "p99_ms": round(dp99 * 1e3, 2) if dp99 else None,
+                        "calm_p99_ms": round(calm_p99 * 1e3, 2),
+                        "p99_bound_ms": round(bound * 1e3, 2),
+                        "evicted_in_s": (round(evicted_in, 3)
+                                         if evicted_in else None),
+                        "retries": snap["retries"],
+                        "gate_zero_errors": len(drill_errors) == 0,
+                        "gate_p99_bounded": bool(dp99 and dp99 <= bound),
+                    }
+            finally:
+                if router is not None:
+                    router.close(stop_replicas=True)
+                else:
+                    fleet.close(stop_replicas=True)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    top = scaling[str(max(int(k) for k in scaling))]
+    return {"value": top["rows_per_sec"], "unit": "rows/sec",
+            "replicas_at_value": max(int(k) for k in scaling),
+            "scaling": scaling,
+            "availability_drill": drill,
+            "threads": n_threads, "rows_per_request": rows}
+
+
 def bench_checkpoint():
     """Checkpoint subsystem config (docs/CHECKPOINTS.md): (a) the
     per-autosave STEP-LOOP STALL — blocking single-file npz writer
@@ -893,6 +1066,7 @@ CONFIGS = {
     "feed": bench_feed,
     "guardian": bench_guardian,
     "serve": bench_serve,
+    "fleet": bench_fleet,
     "checkpoint": bench_checkpoint,
     "telemetry": bench_telemetry,
     "lenet": bench_lenet,
@@ -908,6 +1082,7 @@ METRIC_NAMES = {
     "feed": "device_feed_ragged_stream_steps_per_sec",
     "guardian": "guardian_guarded_step_time_ms",
     "serve": "serving_decode_tokens_per_sec_cached",
+    "fleet": "fleet_predict_rows_per_sec_4_replicas",
     "checkpoint": "checkpoint_async_save_stall_ms",
     "telemetry": "telemetry_instrumented_step_time_ms",
     "lenet": "lenet_mnist_step_time_ms",

@@ -13,6 +13,7 @@ Usage:
     python -m deeplearning4j_tpu.cli test    -i data.csv -m model.ckpt
     python -m deeplearning4j_tpu.cli predict -i data.csv -m model.ckpt -o preds.csv
     python -m deeplearning4j_tpu.cli serve   -m model.ckpt --port 8000
+    python -m deeplearning4j_tpu.cli fleet   -m model.ckpt --replicas 3 --port 8000
     python -m deeplearning4j_tpu.cli checkpoint inspect ckpts/
 
 `-m` accepts a conf .json (fresh net), a single-file .ckpt, or a sharded
@@ -207,8 +208,10 @@ def cmd_serve(args) -> int:
             net, host=args.host, port=args.port, n_replicas=args.replicas,
             max_batch_size=args.max_batch_size,
             max_delay_ms=args.max_delay_ms,
+            max_queue=args.max_queue,
             slots=args.slots, page_size=args.page_size,
-            warmup_shape=(n_in,) if (args.warmup and n_in) else None)
+            warmup_shape=(n_in,) if (args.warmup and n_in) else None,
+            warmup_async=args.warmup_async)
     except BaseException:
         tele.close()
         raise
@@ -230,6 +233,75 @@ def cmd_serve(args) -> int:
         pass
     finally:
         handle.close()
+        tele.close()
+    return 0
+
+
+def cmd_fleet(args) -> int:
+    """`fleet`: spawn N local replica server processes (and/or attach
+    running ones by URL) behind the router tier — health-based
+    eviction/rejoin, least-loaded routing with retries, load shedding,
+    rolling `POST /reload`, `POST /scale` (docs/FLEET.md)."""
+    from deeplearning4j_tpu.serving.fleet import (Autoscaler, Fleet,
+                                                  ReplicaSpawner)
+    from deeplearning4j_tpu.serving.router import serve_fleet
+
+    if not args.attach and (not args.model or args.replicas < 1):
+        print("fleet needs -m MODEL with --replicas >= 1, and/or "
+              "--attach URL", file=sys.stderr)
+        return 2
+    autoscaler = None
+    if args.autoscale:
+        lo, _, hi = args.autoscale.partition(":")
+        autoscaler = Autoscaler(min_replicas=int(lo),
+                                max_replicas=int(hi or lo))
+    spawner = None
+    if args.model and (args.replicas > 0 or autoscaler is not None):
+        spawner = ReplicaSpawner(args.model, serve_args=args.serve_arg)
+    tele = _Telemetry(args)
+    fleet = Fleet(spawner=spawner,
+                  heartbeat_interval=args.heartbeat_interval,
+                  heartbeat_timeout=args.heartbeat_timeout,
+                  shed_high_water=args.shed_high_water,
+                  autoscaler=autoscaler,
+                  initial_checkpoint=(args.model
+                                      if args.model
+                                      and not args.model.endswith(".json")
+                                      else None))
+    handle = None
+    try:
+        for url in args.attach:
+            fleet.attach(url)
+        if spawner is not None and args.replicas > 0:
+            fleet.spawn(args.replicas)
+        handle = serve_fleet(fleet, host=args.host, port=args.port)
+        fleet.wait_ready(1, timeout=args.ready_timeout)
+    except BaseException:
+        if handle is not None:
+            handle.close(stop_replicas=True)
+        else:
+            fleet.close(stop_replicas=True)
+        tele.close()
+        raise
+    # snapshot() reads membership under the fleet lock — the monitor
+    # thread may be autoscale-spawning concurrently
+    print(json.dumps({"router": handle.url,
+                      "replicas": fleet.state_counts(),
+                      "endpoints": [rep["url"] for rep in
+                                    fleet.snapshot()["replicas"]
+                                    .values()],
+                      "metrics": handle.url + "/metrics",
+                      **tele.announce()}), flush=True)
+    if args.smoke:
+        handle.close(stop_replicas=True)
+        tele.close()
+        return 0
+    try:
+        handle.http.thread.join()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        handle.close(stop_replicas=True)
         tele.close()
     return 0
 
@@ -370,10 +442,59 @@ def build_parser() -> argparse.ArgumentParser:
     p_serve.add_argument("--no-warmup", dest="warmup",
                          action="store_false",
                          help="skip precompiling the bucket programs")
+    p_serve.add_argument("--warmup-async", action="store_true",
+                         help="open the socket first and warm up on a "
+                              "background thread; /readyz answers 503 "
+                              "until the precompile lands (how fleet "
+                              "replicas hide spin-up, docs/FLEET.md)")
+    p_serve.add_argument("--max-queue", type=int, default=None,
+                         help="bound the /predict coalescing queue; "
+                              "past it requests shed with 503 + "
+                              "Retry-After")
     p_serve.add_argument("--smoke", action="store_true",
                          help="start, print the address, shut down")
     telemetry_flags(p_serve)
     p_serve.set_defaults(fn=cmd_serve)
+
+    p_fleet = sub.add_parser(
+        "fleet",
+        help="router tier over N replica server processes "
+             "(docs/FLEET.md)")
+    p_fleet.add_argument("--model", "-m", default=None,
+                         help="checkpoint/conf served by spawned "
+                              "replicas (optional with --attach)")
+    p_fleet.add_argument("--replicas", type=int, default=2,
+                         help="replica processes to spawn locally "
+                              "(0 = attach-only)")
+    p_fleet.add_argument("--attach", action="append", default=[],
+                         metavar="URL",
+                         help="attach an already-running replica "
+                              "endpoint (repeatable)")
+    p_fleet.add_argument("--host", default="127.0.0.1")
+    p_fleet.add_argument("--port", type=int, default=0,
+                         help="router port; 0 = auto-assign (printed)")
+    p_fleet.add_argument("--heartbeat-interval", type=float, default=0.5)
+    p_fleet.add_argument("--heartbeat-timeout", type=float, default=3.0,
+                         help="evict a replica whose liveness probe "
+                              "has not succeeded for this long")
+    p_fleet.add_argument("--shed-high-water", type=int, default=None,
+                         help="shed (503 + Retry-After) when this many "
+                              "requests are in flight fleet-wide")
+    p_fleet.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                         help="enable the autoscaling hook between MIN "
+                              "and MAX replicas (queue-depth driven)")
+    p_fleet.add_argument("--ready-timeout", type=float, default=180.0,
+                         help="wait this long for the first replica to "
+                              "pass /readyz before announcing")
+    p_fleet.add_argument("--serve-arg", action="append", default=[],
+                         metavar="ARG",
+                         help="extra flag forwarded to each spawned "
+                              "replica's `serve` (repeatable)")
+    p_fleet.add_argument("--smoke", action="store_true",
+                         help="start, print the address, shut down "
+                              "(stops spawned replicas)")
+    telemetry_flags(p_fleet)
+    p_fleet.set_defaults(fn=cmd_fleet)
     return parser
 
 

@@ -11,11 +11,28 @@ makes autoregressive decode O(1) per token, and a `DecodeLoop`
 slot-schedules concurrent generate streams over a paged KV block pool
 (`PagedKVPool`) under ONE compiled decode step — requests join/leave at
 token boundaries, KV memory scales with written tokens, `/generate`
-streams tokens as they emit. A `ReplicaSet` round-robins engines across
-local devices. See docs/SERVING.md.
+streams tokens as they emit. A `ReplicaSet` spreads engines across
+local devices (least-outstanding dispatch). Above the single process,
+a `Fleet` + router tier (`serving/fleet.py`, `serving/router.py`)
+dispatches over N out-of-process replica servers with health-based
+eviction/readmission, load shedding, rolling checkpoint reload and an
+autoscaling hook. See docs/SERVING.md and docs/FLEET.md.
 """
 
 from deeplearning4j_tpu.serving.batcher import MicroBatcher  # noqa: F401
+from deeplearning4j_tpu.serving.errors import OverloadedError  # noqa: F401
+from deeplearning4j_tpu.serving.fleet import (  # noqa: F401
+    Autoscaler,
+    Fleet,
+    FleetReplica,
+    NoReadyReplicas,
+    ReplicaSpawner,
+)
+from deeplearning4j_tpu.serving.router import (  # noqa: F401
+    FleetHandle,
+    ReplicaClient,
+    serve_fleet,
+)
 from deeplearning4j_tpu.serving.decode_loop import (  # noqa: F401
     DecodeLoop,
     GenerationStream,

@@ -29,6 +29,10 @@ Contract:
 - A request that would overflow `max_batch_size` is held for the next
   batch (never split across two forwards), so one future always maps to
   one contiguous row range of one engine call.
+- **Admission backpressure**: with `max_queue=N`, a submit that finds N
+  requests already waiting raises `OverloadedError` (503 + Retry-After
+  on the HTTP surface, docs/FLEET.md) instead of queueing unboundedly —
+  shedding at the door beats timing out after the queue.
 - `close()` stops accepting submits, flushes everything already queued,
   and joins the worker. Also usable as a context manager.
 """
@@ -45,6 +49,7 @@ from typing import Callable, NamedTuple, Optional
 import numpy as np
 
 from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.serving.errors import OverloadedError
 
 __all__ = ["MicroBatcher"]
 
@@ -74,6 +79,7 @@ def _resolve(fut: Future, value=None, exc: Optional[BaseException] = None
 class MicroBatcher:
     def __init__(self, run_batch: Callable[[np.ndarray], np.ndarray], *,
                  max_batch_size: int = 64, max_delay_ms: float = 2.0,
+                 max_queue: Optional[int] = None,
                  name: str = "micro-batcher"):
         if max_batch_size < 1:
             raise ValueError(
@@ -81,9 +87,12 @@ class MicroBatcher:
         if max_delay_ms < 0:
             raise ValueError(
                 f"max_delay_ms must be >= 0, got {max_delay_ms}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self._run = run_batch
         self.max_batch_size = int(max_batch_size)
         self.max_delay_s = max_delay_ms / 1000.0
+        self.max_queue = None if max_queue is None else int(max_queue)
         self._q: "queue.Queue" = queue.Queue()
         self._closed = False
         self._lock = threading.Lock()
@@ -104,6 +113,10 @@ class MicroBatcher:
         self._m_rows = reg.counter(
             "dl4j_batcher_rows", "rows shipped in coalesced batches"
         ).labels(**lab)
+        self._m_shed = reg.counter(
+            "dl4j_batcher_shed",
+            "requests rejected at submit because the coalescing queue "
+            "was at max_queue").labels(**lab)
         self._m_queue = reg.gauge(
             "dl4j_batcher_queue_depth",
             "requests waiting in the coalescing queue").labels(**lab)
@@ -155,6 +168,15 @@ class MicroBatcher:
             if self._closed:
                 fut.set_exception(RuntimeError("batcher is closed"))
                 return fut
+            if (self.max_queue is not None
+                    and self._q.qsize() >= self.max_queue):
+                # shed at the door: raising (not poisoning the future)
+                # lets callers that route/queue-manage see the signal
+                # before any work is enqueued
+                self._m_shed.inc()
+                raise OverloadedError(
+                    f"batcher queue full ({self.max_queue} waiting)",
+                    retry_after_ms=max(50, int(self.max_delay_s * 2000)))
             self._m_submitted.inc()
             # enqueue under the lock: close() also takes it before
             # putting the sentinel, so no request can land AFTER _CLOSE
@@ -261,7 +283,9 @@ class MicroBatcher:
             "batches": batches,
             "mean_rows_per_batch": round(per_batch, 2),
             "occupancy": round(per_batch / self.max_batch_size, 4),
+            "shed": int(self._m_shed.value),
             "queue_depth": self._q.qsize(),
             "max_batch_size": self.max_batch_size,
+            "max_queue": self.max_queue,
             "max_delay_ms": self.max_delay_s * 1000.0,
         }

@@ -70,6 +70,7 @@ import numpy as np
 
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.models.transformer import TransformerConfig
+from deeplearning4j_tpu.serving.errors import OverloadedError
 from deeplearning4j_tpu.serving.paged_kv import (init_paged_pool,
                                                  paged_decode_step,
                                                  paged_kv_bytes,
@@ -180,8 +181,8 @@ class DecodeLoop:
 
     def __init__(self, params, cfg: TransformerConfig, *, slots: int = 8,
                  page_size: int = 16, n_pages: Optional[int] = None,
-                 horizon: int = 1, start: bool = True,
-                 name: Optional[str] = None):
+                 horizon: int = 1, max_waiting: Optional[int] = None,
+                 start: bool = True, name: Optional[str] = None):
         import jax
         import jax.numpy as jnp
 
@@ -189,6 +190,9 @@ class DecodeLoop:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if horizon < 1:
             raise ValueError(f"horizon must be >= 1, got {horizon}")
+        if max_waiting is not None and max_waiting < 0:
+            raise ValueError(
+                f"max_waiting must be >= 0, got {max_waiting}")
         self.cfg = cfg
         self.params = params
         self.slots = int(slots)
@@ -200,6 +204,10 @@ class DecodeLoop:
             # chasing HBM set it lower and lean on the backpressure
             n_pages = self.slots * self._pps
         self.n_pages = int(n_pages)
+        #: admission-queue bound: a submit that cannot start immediately
+        #: while this many requests already wait sheds with
+        #: OverloadedError (None = queue unboundedly, legacy behavior)
+        self.max_waiting = None if max_waiting is None else int(max_waiting)
         self._buckets = prompt_buckets(cfg, self.page_size)
 
         # device state ------------------------------------------------
@@ -282,6 +290,10 @@ class DecodeLoop:
             "dl4j_decode_steps",
             "compiled decode dispatches run (each covers `horizon` "
             "token steps)").labels(**lab)
+        self._m_shed = reg.counter(
+            "dl4j_decode_shed",
+            "generate requests rejected at submit because the admission "
+            "queue was at max_waiting").labels(**lab)
         reg.gauge(
             "dl4j_kv_pages_total",
             "usable KV pages in the block pool").labels(**lab).set(
@@ -331,15 +343,46 @@ class DecodeLoop:
         """Queue one prompt (1-D int sequence). The stream's first token
         arrives after admission + prefill; termination on EOS (when
         given), `max_tokens`, or the model window."""
-        prompt = self.validate(prompt, max_tokens)
-        stream = GenerationStream(prompt, max_tokens, eos_id)
+        return self.submit_many([prompt], max_tokens, eos_id)[0]
+
+    def submit_many(self, prompts, max_tokens: int,
+                    eos_id: Optional[int] = None
+                    ) -> List[GenerationStream]:
+        """Admit several rows as ONE unit: all rows enqueue or none do.
+        A shed that fired between a multi-row request's submits would
+        orphan the already-queued row-mates in running slots (no
+        consumer ever reads them), so the /generate handler routes
+        every multi-row body through here."""
+        prompts = [self.validate(p, max_tokens) for p in prompts]
+        streams = [GenerationStream(p, max_tokens, eos_id)
+                   for p in prompts]
         with self._cond:
             if self._closed:
                 raise RuntimeError("decode loop is closed")
-            self._m_requests.inc()
-            self._waiting.append(stream)
+            if self.max_waiting is not None:
+                # free-page starvation / slot saturation sheds at the
+                # door once the admission queue is at its bound — a
+                # group that could start right now is never rejected
+                need = sum(pages_for_tokens(p.size + 1, self.page_size)
+                           for p in prompts)
+                free_slots = sum(1 for s in self._slot_state
+                                 if s is None)
+                can_now = (not self._waiting
+                           and len(self._free) >= need
+                           and free_slots >= len(prompts))
+                if (not can_now and len(self._waiting) + len(prompts)
+                        > self.max_waiting):
+                    self._m_shed.inc()
+                    raise OverloadedError(
+                        f"decode admission queue full "
+                        f"({len(self._waiting)} waiting, "
+                        f"{len(self._free)}/{self.n_pages} pages free)",
+                        retry_after_ms=250)
+            for stream in streams:
+                self._m_requests.inc()
+                self._waiting.append(stream)
             self._cond.notify_all()
-        return stream
+        return streams
 
     def generate(self, prompt, max_tokens: int,
                  eos_id: Optional[int] = None,
@@ -355,6 +398,20 @@ class DecodeLoop:
     @property
     def occupied_slots(self) -> int:
         return sum(1 for s in self._slot_state if s is not None)
+
+    @property
+    def load(self) -> int:
+        """Live in-flight pressure: queued + occupied slots. The
+        replica-set and fleet least-loaded selectors key on this."""
+        with self._cond:
+            return len(self._waiting) + self.occupied_slots
+
+    @property
+    def alive(self) -> bool:
+        """Scheduler thread running (readiness surface: a dead loop
+        must flip /readyz, not hang clients)."""
+        return (self._thread is not None and self._thread.is_alive()
+                and not self._closed)
 
     def kv_pool_bytes(self) -> int:
         return paged_kv_bytes(self.cfg, self.n_pages, self.page_size)
@@ -383,8 +440,10 @@ class DecodeLoop:
                 "pages_in_use": self.pages_in_use,
                 "peak_pages_in_use": self._peak_pages,
                 "pool_bytes": self.kv_pool_bytes(),
+                "max_waiting": self.max_waiting,
                 "requests": int(self._m_requests.value),
                 "tokens_streamed": int(self._m_tokens.value),
+                "shed": int(self._m_shed.value),
                 "admission_waits": int(self._m_waits.value),
                 "dispatches": int(self._m_steps.value),
                 "decode_step_programs": self.decode_step_programs(),

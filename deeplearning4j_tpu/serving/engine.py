@@ -171,6 +171,9 @@ class InferenceEngine:
         #: start_decode_loop) — None until started
         self.decode_loop = None
         self._tf_cfg = None
+        #: True once warmup() precompiled every bucket — the readiness
+        #: surface (/readyz, docs/FLEET.md) reads it
+        self.warmed_up = False
         self.stats = EngineStats()
         from deeplearning4j_tpu.telemetry import device as _tdev
         _tdev.watch_jit_cache("serving_engine", self.program_cache_size)
@@ -187,12 +190,14 @@ class InferenceEngine:
     def for_transformer(cls, params, cfg, *, decode_slots: int = 0,
                         page_size: int = 16,
                         kv_pages: Optional[int] = None,
+                        max_waiting: Optional[int] = None,
                         **kw) -> "InferenceEngine":
         """Wrap a transformer LM: apply = full logits (B, T, vocab);
         `generate()` runs the per-request KV-cached compiled scan.
         `decode_slots > 0` additionally starts the continuous-batching
         `DecodeLoop` (paged KV pool, `generate_stream()`); pass
-        `page_size`/`kv_pages` to size the pool (docs/SERVING.md)."""
+        `page_size`/`kv_pages` to size the pool and `max_waiting` to
+        bound its admission queue (docs/SERVING.md)."""
         from deeplearning4j_tpu.models.transformer import transformer_logits
         from deeplearning4j_tpu.serving.kv_cache import generate_cached
 
@@ -203,7 +208,8 @@ class InferenceEngine:
         eng._tf_cfg = cfg
         if decode_slots:
             eng.start_decode_loop(slots=decode_slots, page_size=page_size,
-                                  n_pages=kv_pages)
+                                  n_pages=kv_pages,
+                                  max_waiting=max_waiting)
         return eng
 
     @classmethod
@@ -275,7 +281,8 @@ class InferenceEngine:
     # ------------------------------------------- continuous batching
     def start_decode_loop(self, slots: int = 8, page_size: int = 16,
                           n_pages: Optional[int] = None,
-                          horizon: int = 1):
+                          horizon: int = 1,
+                          max_waiting: Optional[int] = None):
         """Start the continuous-batching slot scheduler
         (serving/decode_loop.py) for this transformer engine: S slots
         over a paged KV pool riding ONE compiled decode step. `/generate`
@@ -292,7 +299,8 @@ class InferenceEngine:
             raise RuntimeError("decode loop already started")
         self.decode_loop = DecodeLoop(self._params, self._tf_cfg,
                                       slots=slots, page_size=page_size,
-                                      n_pages=n_pages, horizon=horizon)
+                                      n_pages=n_pages, horizon=horizon,
+                                      max_waiting=max_waiting)
         return self.decode_loop
 
     def generate_stream(self, prompt, max_tokens: int,
@@ -355,6 +363,7 @@ class InferenceEngine:
             xb = jax.device_put(np.zeros((b, *feature_shape), dtype),
                                 self.device)
             np.asarray(self._jit(self._params, xb))
+        self.warmed_up = True
 
     def program_cache_size(self) -> int:
         """Compiled-program count for the jitted forward — the serving

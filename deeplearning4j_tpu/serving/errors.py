@@ -1,0 +1,39 @@
+"""Serving-plane error types shared by the single-host server and the
+fleet router.
+
+`OverloadedError` is the machine-actionable shedding signal: every
+admission point that can saturate (the micro-batcher's coalescing
+queue, the decode loop's admission queue, the fleet's global
+outstanding-request high-water mark) raises it instead of a generic
+RuntimeError, and every HTTP front end maps it to the same wire shape —
+`503` with a `Retry-After` header and a JSON body
+`{"error": "overloaded", "retry_after_ms": N}` — so clients and load
+balancers can back off without parsing prose (docs/FLEET.md).
+"""
+
+from __future__ import annotations
+
+import math
+
+__all__ = ["OverloadedError", "overload_body"]
+
+
+class OverloadedError(RuntimeError):
+    """An admission queue is full (or a shed high-water mark is hit);
+    the caller should retry after `retry_after_ms`."""
+
+    def __init__(self, message: str, retry_after_ms: int = 1000):
+        super().__init__(message)
+        self.retry_after_ms = max(1, int(retry_after_ms))
+
+    @property
+    def retry_after_s(self) -> int:
+        """Whole seconds for the `Retry-After` header (ceil, >= 1)."""
+        return max(1, math.ceil(self.retry_after_ms / 1000.0))
+
+
+def overload_body(exc: OverloadedError) -> dict:
+    """The JSON body every 503-overloaded reply carries."""
+    return {"error": "overloaded",
+            "retry_after_ms": exc.retry_after_ms,
+            "detail": str(exc)}

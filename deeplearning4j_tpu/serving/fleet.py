@@ -1,0 +1,904 @@
+"""Elastic serving fleet: health-tracked replicas behind a router tier.
+
+`serving/replicas.py` scales one process across local chips; this
+module scales across PROCESSES (and hosts): a `Fleet` owns N replica
+endpoints — each a full `serve_network` server, spawned locally by a
+`ReplicaSpawner` or attached by URL — and the router
+(`serving/router.py`) dispatches over them. The design deliberately
+reuses the scaleout control-plane idioms (ROADMAP "Elastic serving
+fleet"): replica health IS worker health, so the fleet rides the same
+`InMemoryStateTracker` the distributed runtime uses —
+`tracker.heartbeat()` on every successful liveness probe (which
+re-registers an evicted member, the tracker's elasticity contract),
+`tracker.stale_workers()` to find the dead, the `runtime._evict_stale`
+shape for eviction. The whole-program-compilation framing of
+arXiv:1810.09868 motivates the readiness split: a replica is a
+compiled-once program whose spin-up (warmup precompile) is hidden
+behind the router — `/healthz` up but `/readyz` 503 means "alive,
+still compiling", and the router admits it only when readiness lands.
+
+Replica lifecycle:
+
+```
+ attach()/spawn()          readyz ok                 heartbeat stale /
+      │                       │                      conn refused
+      ▼                       ▼                            │
+  STARTING ───────────────► READY ◄────────────────┐       ▼
+                              │     readyz ok      │   EVICTED ◄──┐
+                              │  (readmission)     └───────┤      │
+                     drain for reload/retire               │ probes keep
+                              ▼                            │ running: a
+                          DRAINING ──► READY / retired     │ rejoining
+                                                           └─ replica is
+                                                              readmitted
+```
+
+Routing is least-outstanding-requests over READY replicas (round-robin
+tiebreak — the same policy `ReplicaSet` applies intra-process), with:
+
+- **retries**: idempotent `/predict` replays on a healthy peer after a
+  connection failure or replica 5xx; a connection-level failure also
+  evicts the replica immediately (faster than the heartbeat timeout —
+  the monitor readmits it when it answers `/readyz` again).
+- **load shedding**: total in-flight past `shed_high_water` answers
+  503 + `Retry-After` + `{"error": "overloaded", ...}` before any
+  replica is touched.
+- **rolling/canary reload** (`rolling_reload`): drain -> per-replica
+  `POST /reload` -> `/readyz` probe (-> optional `/predict` validation
+  probe) -> readmit, one replica at a time; the first replica is the
+  canary — if it fails, replicas already on the new checkpoint roll
+  back to the previous one automatically and the fleet stays
+  consistent. A replica whose `/reload` itself failed kept its old
+  weights (the engine's validated atomic swap), so only
+  probe-stage failures need a rollback of the failed member.
+- **autoscaling hook** (`Autoscaler` + a spawner): queue-depth
+  (outstanding-per-replica) signals spawn or retire replicas between
+  `min_replicas`/`max_replicas` with a cooldown; `scale_to(n)` is the
+  manual twin (router `POST /scale`).
+
+Telemetry (`dl4j_fleet_*`, docs/OBSERVABILITY.md):
+`dl4j_fleet_replicas{state=}` gauges, request/retry/shed/eviction/
+readmission/reload counters, per-route latency histograms,
+`dl4j_fleet_outstanding`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.scaleout.statetracker import InMemoryStateTracker
+from deeplearning4j_tpu.serving.errors import OverloadedError
+from deeplearning4j_tpu.serving.router import ReplicaClient
+
+__all__ = ["Fleet", "FleetReplica", "ReplicaSpawner", "Autoscaler",
+           "NoReadyReplicas",
+           "STARTING", "READY", "DRAINING", "EVICTED"]
+
+log = logging.getLogger(__name__)
+
+STARTING = "starting"
+READY = "ready"
+DRAINING = "draining"
+EVICTED = "evicted"
+STATES = (STARTING, READY, DRAINING, EVICTED)
+
+_fleet_seq = itertools.count()
+
+
+class NoReadyReplicas(RuntimeError):
+    """No replica is in the READY state (the router answers 503)."""
+
+
+class FleetReplica:
+    """Router-side record of one replica endpoint. Mutable fields
+    (`state`, `outstanding`, `failures`) are guarded by the owning
+    fleet's lock."""
+
+    def __init__(self, replica_id: str, client: ReplicaClient,
+                 proc: Optional[subprocess.Popen] = None,
+                 spawned: bool = False):
+        self.id = replica_id
+        self.client = client
+        self.proc = proc
+        self.spawned = spawned
+        self.state = STARTING
+        self.outstanding = 0
+        self.failures = 0          # consecutive request-path failures
+        self.last_ready: Optional[dict] = None
+        self.admitted_at: Optional[float] = None
+        self.evicted_at: Optional[float] = None
+        self.eviction_reason: Optional[str] = None
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        now = now if now is not None else time.time()
+        out = {"url": self.client.url, "state": self.state,
+               "outstanding": self.outstanding,
+               "failures": self.failures, "spawned": self.spawned}
+        if self.proc is not None:
+            out["pid"] = self.proc.pid
+            out["proc_alive"] = self.proc.poll() is None
+        if self.admitted_at is not None:
+            out["admitted_age_s"] = round(now - self.admitted_at, 3)
+        if self.state == EVICTED and self.evicted_at is not None:
+            out["evicted_age_s"] = round(now - self.evicted_at, 3)
+            out["eviction_reason"] = self.eviction_reason
+        return out
+
+
+class ReplicaSpawner:
+    """Spawns local replica server processes (`cli serve` with async
+    warmup) and reads each one's announce line for its URL.
+
+    This is the single-host spawner (the autoscaling hook's local
+    backend and the test/bench harness); a multi-host deployment
+    attaches remote replicas by URL instead and brings its own process
+    manager."""
+
+    def __init__(self, model_path: str, *, host: str = "127.0.0.1",
+                 serve_args: Sequence[str] = (),
+                 env: Optional[dict] = None,
+                 python: Optional[str] = None,
+                 announce_timeout: float = 180.0):
+        self.model_path = str(model_path)
+        self.host = host
+        self.serve_args = list(serve_args)
+        self.env = dict(env) if env is not None else dict(os.environ)
+        self.python = python or sys.executable
+        self.announce_timeout = float(announce_timeout)
+
+    def command(self, port: int = 0) -> List[str]:
+        return ([self.python, "-m", "deeplearning4j_tpu.cli", "serve",
+                 "-m", self.model_path, "--host", self.host,
+                 "--port", str(port), "--warmup-async"]
+                + self.serve_args)
+
+    def spawn(self, port: int = 0
+              ) -> Tuple[subprocess.Popen, str]:
+        """Launch one replica process; returns (proc, url). The
+        replica announces fast (async warmup) — readiness is gated by
+        its /readyz, not by this call."""
+        proc = subprocess.Popen(
+            self.command(port), env=self.env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        url = self._read_announce(proc)
+        return proc, url
+
+    def _read_announce(self, proc: subprocess.Popen) -> str:
+        """First stdout line is the serve announce JSON; a stdout drain
+        thread keeps running afterwards so the child never blocks on a
+        full pipe (its tail is kept for post-mortem errors)."""
+        tail: deque = deque(maxlen=50)
+        found: List[str] = []
+        got = threading.Event()
+
+        def drain():
+            for line in proc.stdout:
+                tail.append(line.rstrip())
+                if not found and line.lstrip().startswith("{"):
+                    try:
+                        if "serving" in json.loads(line):
+                            found.append(line)
+                            got.set()
+                    except ValueError:
+                        pass
+            got.set()  # EOF
+
+        t = threading.Thread(target=drain, daemon=True,
+                             name="replica-announce")
+        t.start()
+        if not got.wait(self.announce_timeout) or not found:
+            proc.kill()
+            raise RuntimeError(
+                "replica process produced no announce line within "
+                f"{self.announce_timeout}s; output tail:\n"
+                + "\n".join(tail))
+        return json.loads(found[0])["serving"]
+
+    @staticmethod
+    def stop(proc: subprocess.Popen, timeout: float = 10.0) -> None:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=timeout)
+
+
+class Autoscaler:
+    """Queue-depth-driven scaling policy: spawn when mean outstanding
+    per ready replica crosses `scale_up_at`, retire when it falls under
+    `scale_down_at`, bounded by [min_replicas, max_replicas] with a
+    cooldown between actions. Pure policy — the Fleet applies the
+    decision (`Fleet.autoscale_tick`), so tests drive it with synthetic
+    load and a fake spawner."""
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 4,
+                 scale_up_at: float = 4.0, scale_down_at: float = 0.5,
+                 cooldown_s: float = 10.0):
+        if not 1 <= min_replicas <= max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{min_replicas}..{max_replicas}")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.scale_up_at = float(scale_up_at)
+        self.scale_down_at = float(scale_down_at)
+        self.cooldown_s = float(cooldown_s)
+        self._last_action = 0.0
+
+    def decide(self, n_replicas: int, outstanding: int) -> int:
+        """-1 / 0 / +1 given live replica count and total in-flight."""
+        if n_replicas < self.min_replicas:
+            return 1  # below floor: act regardless of cooldown
+        if time.monotonic() - self._last_action < self.cooldown_s:
+            return 0
+        per = outstanding / max(1, n_replicas)
+        if per >= self.scale_up_at and n_replicas < self.max_replicas:
+            return 1
+        if per <= self.scale_down_at and n_replicas > self.min_replicas:
+            return -1
+        return 0
+
+    def note_action(self) -> None:
+        self._last_action = time.monotonic()
+
+
+class Fleet:
+    """N replica endpoints + health tracking + dispatch policy."""
+
+    def __init__(self, *, spawner: Optional[ReplicaSpawner] = None,
+                 heartbeat_interval: float = 0.5,
+                 heartbeat_timeout: float = 3.0,
+                 shed_high_water: Optional[int] = None,
+                 probe_timeout: float = 2.0,
+                 request_timeout: float = 60.0,
+                 generate_timeout: float = 300.0,
+                 autoscaler: Optional[Autoscaler] = None,
+                 initial_checkpoint: Optional[str] = None,
+                 name: Optional[str] = None,
+                 start: bool = True):
+        self.spawner = spawner
+        self.autoscaler = autoscaler
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.shed_high_water = shed_high_water
+        self.probe_timeout = float(probe_timeout)
+        self.request_timeout = float(request_timeout)
+        self.generate_timeout = float(generate_timeout)
+        #: checkpoint the fleet currently serves — the implicit
+        #: rollback target of a failed canary (rolling_reload updates
+        #: it; None until a reload or an explicit initial_checkpoint)
+        self.current_checkpoint = initial_checkpoint
+        # the scaleout control-plane tracker IS the health store:
+        # heartbeat() on probe success (re-registers evicted members),
+        # stale_workers() drives eviction — runtime._evict_stale's idiom
+        self.tracker = InMemoryStateTracker(
+            heartbeat_timeout=heartbeat_timeout)
+        self._replicas: Dict[str, FleetReplica] = {}  # insertion order
+        self._lock = threading.RLock()
+        self._rr = 0
+        self._rid_seq = itertools.count()
+        self._reload_lock = threading.Lock()
+        self._reload_active = False
+        self._closed = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+        # telemetry ----------------------------------------------------
+        reg = telemetry.get_registry()
+        self.label = name if name is not None else f"f{next(_fleet_seq)}"
+        lab = {"fleet": self.label}
+        self._m_requests = {
+            route: reg.counter(
+                "dl4j_fleet_requests",
+                "requests routed by the fleet tier").labels(
+                    route=route, **lab)
+            for route in ("predict", "generate")}
+        self._m_latency = {
+            route: reg.histogram(
+                "dl4j_fleet_request_latency_seconds",
+                "router-side request wall latency (incl. retries)"
+            ).labels(route=route, **lab)
+            for route in ("predict", "generate")}
+        self._m_shed = {
+            route: reg.counter(
+                "dl4j_fleet_shed",
+                "requests shed at the router's high-water mark").labels(
+                    route=route, **lab)
+            for route in ("predict", "generate")}
+        self._m_retries = reg.counter(
+            "dl4j_fleet_retries",
+            "predict retries on a healthy peer after a replica "
+            "failure").labels(**lab)
+        self._m_evictions = reg.counter(
+            "dl4j_fleet_evictions",
+            "replicas evicted (stale heartbeat, lost readiness, or "
+            "connection failure)").labels(**lab)
+        self._m_readmissions = reg.counter(
+            "dl4j_fleet_readmissions",
+            "evicted replicas readmitted after passing /readyz").labels(
+                **lab)
+        self._m_reloads = {
+            outcome: reg.counter(
+                "dl4j_fleet_reloads",
+                "rolling checkpoint reloads by outcome").labels(
+                    outcome=outcome, **lab)
+            for outcome in ("ok", "rolled_back", "failed")}
+        self._m_spawned = reg.counter(
+            "dl4j_fleet_spawned", "replicas spawned").labels(**lab)
+        self._m_retired = reg.counter(
+            "dl4j_fleet_retired", "replicas retired").labels(**lab)
+        ref = weakref.ref(self)
+        for state in STATES:
+            reg.gauge(
+                "dl4j_fleet_replicas",
+                "fleet replicas by lifecycle state").labels(
+                    state=state, **lab).set_function(
+                (lambda st: lambda: (
+                    (lambda o: o.state_counts().get(st, 0) if o else 0)(
+                        ref())))(state))
+        reg.gauge(
+            "dl4j_fleet_outstanding",
+            "in-flight requests across the fleet").labels(
+                **lab).set_function(
+            lambda: (lambda o: o.total_outstanding() if o else 0)(ref()))
+
+        if start:
+            self.start()
+
+    # ------------------------------------------------------- lifecycle
+    def start(self) -> "Fleet":
+        if self._monitor is None or not self._monitor.is_alive():
+            self._closed.clear()
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, daemon=True,
+                name=f"fleet-monitor-{self.label}")
+            self._monitor.start()
+        return self
+
+    def close(self, stop_replicas: bool = False,
+              timeout: float = 10.0) -> None:
+        """Stop the monitor; optionally terminate spawned replica
+        processes (attached-by-URL replicas are never touched)."""
+        self._closed.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=timeout)
+        if stop_replicas:
+            with self._lock:
+                procs = [r.proc for r in self._replicas.values()
+                         if r.spawned and r.proc is not None]
+            for proc in procs:
+                ReplicaSpawner.stop(proc, timeout=timeout)
+
+    def __enter__(self) -> "Fleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(stop_replicas=True)
+
+    # ------------------------------------------------------ membership
+    def attach(self, url: str, replica_id: Optional[str] = None,
+               proc: Optional[subprocess.Popen] = None,
+               spawned: bool = False) -> FleetReplica:
+        """Add a replica endpoint (STARTING until /readyz passes)."""
+        with self._lock:
+            rid = replica_id or f"r{next(self._rid_seq)}"
+            if rid in self._replicas:
+                raise ValueError(f"replica id {rid!r} already attached")
+            rep = FleetReplica(rid, ReplicaClient(url), proc=proc,
+                               spawned=spawned)
+            self._replicas[rid] = rep
+        self.tracker.add_worker(rid)
+        return rep
+
+    def spawn(self, n: int = 1) -> List[FleetReplica]:
+        """Spawn n local replica processes through the spawner."""
+        if self.spawner is None:
+            raise RuntimeError("fleet has no spawner configured")
+        out = []
+        for _ in range(n):
+            proc, url = self.spawner.spawn()
+            out.append(self.attach(url, proc=proc, spawned=True))
+            self._m_spawned.inc()
+        return out
+
+    def retire(self, replica_id: str, drain_timeout: float = 30.0
+               ) -> None:
+        """Drain one replica out of rotation and remove it (terminating
+        its process when the fleet spawned it)."""
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None:
+                raise KeyError(f"no replica {replica_id!r}")
+            rep.state = DRAINING
+        self._drain(rep, drain_timeout)
+        with self._lock:
+            self._replicas.pop(replica_id, None)
+        self.tracker.remove_worker(replica_id)
+        if rep.spawned and rep.proc is not None:
+            ReplicaSpawner.stop(rep.proc)
+        self._m_retired.inc()
+
+    def scale_to(self, n: int, drain_timeout: float = 30.0) -> dict:
+        """Manual autoscaling hook: spawn or retire (least-loaded,
+        fleet-spawned first) until `n` non-evicted replicas remain."""
+        spawned, retired = [], []
+        with self._lock:
+            live = [r for r in self._replicas.values()
+                    if r.state != EVICTED]
+        if len(live) < n:
+            spawned = [r.id for r in self.spawn(n - len(live))]
+        while len(live) > n:
+            # retire the least-loaded spawned replica first; attached
+            # replicas only when nothing spawned remains
+            live.sort(key=lambda r: (not r.spawned, r.outstanding))
+            victim = live.pop(0)
+            self.retire(victim.id, drain_timeout=drain_timeout)
+            retired.append(victim.id)
+        return {"replicas": n, "spawned": spawned, "retired": retired}
+
+    # -------------------------------------------------- health monitor
+    def _monitor_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                self.poll()
+            except Exception:  # the monitor must survive anything
+                log.exception("fleet monitor poll failed")
+            self._closed.wait(self.heartbeat_interval)
+
+    def poll(self) -> None:
+        """One monitor pass: probe every replica, evict the stale,
+        readmit rejoiners, run the autoscaler. Public so tests drive
+        it deterministically."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            self._probe(rep)
+        # the scaleout eviction idiom: stale heartbeats name the dead
+        for wid in self.tracker.stale_workers():
+            with self._lock:
+                rep = self._replicas.get(wid)
+            if rep is not None and rep.state != EVICTED:
+                self._evict(rep, "heartbeat timeout")
+        if self.autoscaler is not None and self.spawner is not None:
+            self.autoscale_tick()
+
+    def _probe(self, rep: FleetReplica) -> None:
+        try:
+            rep.client.healthz(timeout=self.probe_timeout)
+        except Exception:
+            return  # no heartbeat recorded; staleness evicts
+        # liveness ok -> heartbeat (re-registers an evicted member,
+        # InMemoryStateTracker's elasticity contract)
+        self.tracker.heartbeat(rep.id)
+        if rep.state == DRAINING:
+            return  # mid-reload/retire: rolling_reload owns its state
+        try:
+            ready, payload = rep.client.readyz(
+                timeout=self.probe_timeout)
+        except Exception:
+            return
+        rep.last_ready = payload
+        if ready and rep.state in (STARTING, EVICTED):
+            self._admit(rep)
+        elif not ready and rep.state == READY:
+            self._evict(rep, payload.get("reason", "readiness lost"))
+
+    def _admit(self, rep: FleetReplica) -> None:
+        with self._lock:
+            was_evicted = rep.state == EVICTED
+            rep.state = READY
+            rep.failures = 0
+            rep.admitted_at = time.time()
+        if was_evicted:
+            self._m_readmissions.inc()
+            log.info("fleet %s: replica %s readmitted", self.label,
+                     rep.id)
+
+    def _evict(self, rep: FleetReplica, reason: str) -> None:
+        with self._lock:
+            if rep.state == EVICTED:
+                return
+            rep.state = EVICTED
+            rep.evicted_at = time.time()
+            rep.eviction_reason = reason
+        # removed from the registry; the next successful heartbeat
+        # re-registers it (stale_workers stops naming it meanwhile)
+        self.tracker.remove_worker(rep.id)
+        self._m_evictions.inc()
+        log.warning("fleet %s: evicting replica %s (%s)", self.label,
+                    rep.id, reason)
+
+    def note_request_failure(self, rep: FleetReplica,
+                             exc: BaseException) -> None:
+        """Request-path failure feedback. Connection-level failures
+        evict immediately (the process is gone — waiting out the
+        heartbeat just fails more requests); HTTP-level failures only
+        count (the monitor decides on readiness). A request TIMEOUT
+        (socket.timeout is an OSError) means slow, not dead — one
+        pathological request must not cascade-evict replicas that
+        still answer /healthz, so the heartbeat monitor owns that
+        verdict."""
+        with self._lock:
+            rep.failures += 1
+        if isinstance(exc, OSError) and not isinstance(exc, TimeoutError):
+            self._evict(rep, f"connection failure: {exc}")
+
+    # ------------------------------------------------------- dispatch
+    def ready_replicas(self) -> List[FleetReplica]:
+        with self._lock:
+            return [r for r in self._replicas.values()
+                    if r.state == READY]
+
+    def ready_count(self) -> int:
+        return len(self.ready_replicas())
+
+    def wait_ready(self, n: int = 1, timeout: float = 120.0) -> None:
+        """Block until >= n replicas are READY (spin-up gate)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.ready_count() >= n:
+                return
+            time.sleep(min(0.05, self.heartbeat_interval))
+        raise TimeoutError(
+            f"only {self.ready_count()}/{n} replicas ready after "
+            f"{timeout}s: {self.state_counts()}")
+
+    def total_outstanding(self) -> int:
+        with self._lock:
+            return sum(r.outstanding for r in self._replicas.values())
+
+    def state_counts(self) -> Dict[str, int]:
+        with self._lock:
+            counts = {s: 0 for s in STATES}
+            for r in self._replicas.values():
+                counts[r.state] += 1
+            return counts
+
+    def select(self, route: str = "predict",
+               exclude: Sequence[str] = ()) -> FleetReplica:
+        """Least-outstanding READY replica (round-robin tiebreak) —
+        the ReplicaSet policy lifted across processes. Sheds with
+        OverloadedError past the global high-water mark; raises
+        NoReadyReplicas when nothing is admittable. The caller owns
+        `release()`."""
+        with self._lock:
+            ids = list(self._replicas)
+            ready = [r for r in self._replicas.values()
+                     if r.state == READY and r.id not in exclude]
+            if not ready:
+                raise NoReadyReplicas(
+                    f"no ready replica (states: {self.state_counts()})")
+            if self.shed_high_water is not None:
+                total = sum(r.outstanding
+                            for r in self._replicas.values())
+                if total >= self.shed_high_water:
+                    self._m_shed[route].inc()
+                    raise OverloadedError(
+                        f"fleet at high-water mark ({total} in flight "
+                        f">= {self.shed_high_water})",
+                        retry_after_ms=200)
+            n = len(ids)
+            best = min(ready, key=lambda r: (
+                r.outstanding, (ids.index(r.id) - self._rr) % n))
+            self._rr = (ids.index(best.id) + 1) % n
+            best.outstanding += 1
+            if not exclude:
+                # first attempt only: a retried client request counts
+                # ONCE in dl4j_fleet_requests (retries have their own
+                # counter), and retry attempts carry a non-empty
+                # exclude set by construction
+                self._m_requests[route].inc()
+            return best
+
+    def release(self, rep: FleetReplica) -> None:
+        with self._lock:
+            rep.outstanding -= 1
+
+    def observe(self, route: str, seconds: float) -> None:
+        self._m_latency[route].observe(seconds)
+
+    def forward_predict(self, body: bytes
+                        ) -> Tuple[int, dict, bytes]:
+        """Route one /predict: least-loaded replica, transparent retry
+        on a healthy peer after connection failures or replica 5xx
+        (idempotent, so at-least-once is safe). Returns (status,
+        headers, body) from the replica that answered."""
+        start = time.perf_counter()
+        tried: set = set()
+        last_5xx: Optional[Tuple[int, dict, bytes]] = None
+        last_err: Optional[BaseException] = None
+        try:
+            with self._lock:
+                attempts = max(1, len(self._replicas))
+            for _ in range(attempts):
+                try:
+                    rep = self.select(route="predict", exclude=tried)
+                except NoReadyReplicas:
+                    break  # fall through to best-effort answer below
+                if tried:
+                    # a retry is an attempt actually MADE on a peer
+                    # after a failure, not the failure itself
+                    self._m_retries.inc()
+                try:
+                    status, headers, data = rep.client.request(
+                        "POST", "/predict", body,
+                        timeout=self.request_timeout)
+                except Exception as e:
+                    self.note_request_failure(rep, e)
+                    tried.add(rep.id)
+                    last_err = e
+                    continue
+                finally:
+                    self.release(rep)
+                if status >= 500:
+                    # replica answered but failed/shed: try a peer,
+                    # keep the reply in case every peer does the same
+                    tried.add(rep.id)
+                    last_5xx = (status, headers, data)
+                    continue
+                return status, headers, data
+            if last_5xx is not None:
+                return last_5xx
+            raise NoReadyReplicas(
+                "every ready replica failed /predict"
+                + (f" (last error: {last_err})" if last_err else ""))
+        finally:
+            self.observe("predict", time.perf_counter() - start)
+
+    # --------------------------------------------------- rolling reload
+    def _drain(self, rep: FleetReplica, timeout: float) -> bool:
+        """Wait for a DRAINING replica's in-flight requests to land."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if rep.outstanding == 0:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def _reload_one(self, rep: FleetReplica, path: str,
+                    step: Optional[int], probe: Optional[dict],
+                    ready_timeout: float) -> Tuple[bool, dict]:
+        """Reload one drained replica and probe it back to readiness.
+        Returns (ok, info); info["weights_changed"] says whether the
+        replica now holds the NEW checkpoint (reload-stage failures
+        keep the old weights — the engine's validated atomic swap)."""
+        payload = {"path": path}
+        if step is not None:
+            payload["step"] = step
+        try:
+            status, _, data = rep.client.request(
+                "POST", "/reload", json.dumps(payload).encode(),
+                timeout=self.request_timeout)
+        except Exception as e:
+            return False, {"stage": "reload", "weights_changed": False,
+                           "error": f"{type(e).__name__}: {e}"}
+        if status != 200:
+            return False, {"stage": "reload", "weights_changed": False,
+                           "status": status,
+                           "error": data.decode(errors="replace")}
+        # readiness probe: the reload may have cost compile/cache state
+        deadline = time.monotonic() + ready_timeout
+        ready = False
+        while time.monotonic() < deadline:
+            try:
+                ready, _ = rep.client.readyz(timeout=self.probe_timeout)
+            except Exception:
+                ready = False
+            if ready:
+                break
+            time.sleep(0.05)
+        if not ready:
+            return False, {"stage": "readyz", "weights_changed": True,
+                           "error": f"not ready within {ready_timeout}s"}
+        if probe is not None:
+            try:
+                status, _, data = rep.client.request(
+                    "POST", "/predict", json.dumps(probe).encode(),
+                    timeout=self.request_timeout)
+            except Exception as e:
+                return False, {"stage": "probe",
+                               "weights_changed": True,
+                               "error": f"{type(e).__name__}: {e}"}
+            if status != 200:
+                return False, {"stage": "probe",
+                               "weights_changed": True,
+                               "status": status,
+                               "error": data.decode(errors="replace")}
+        return True, {"weights_changed": True}
+
+    def rolling_reload(self, path: str, step: Optional[int] = None,
+                       rollback_path: Optional[str] = None,
+                       rollback_step: Optional[int] = None,
+                       probe: Optional[dict] = None,
+                       drain_timeout: float = 30.0,
+                       ready_timeout: float = 120.0) -> dict:
+        """Orchestrate `POST /reload` across the fleet with zero
+        downtime: one replica at a time — drain (stop routing to it,
+        wait out its in-flight requests), reload, `/readyz`-probe
+        (plus the optional `/predict` validation `probe`), readmit.
+        The FIRST replica is the canary: if it fails validation, the
+        reload aborts and every replica already moved to the new
+        checkpoint rolls back to `rollback_path` (default: the
+        checkpoint the fleet was serving) — the fleet never stays
+        mixed. Requests in flight elsewhere are untouched throughout,
+        and each replica's own swap is atomic, so no response ever
+        mixes old and new weights."""
+        if not self._reload_lock.acquire(blocking=False):
+            raise OverloadedError(
+                "a rolling reload is already in progress",
+                retry_after_ms=5000)
+        self._reload_active = True
+        try:
+            targets = self.ready_replicas()
+            if not targets:
+                raise NoReadyReplicas("no ready replicas to reload")
+            rollback = (rollback_path if rollback_path is not None
+                        else self.current_checkpoint)
+            done: List[str] = []
+            for i, rep in enumerate(targets):
+                with self._lock:
+                    rep.state = DRAINING
+                drained = self._drain(rep, drain_timeout)
+                ok, info = self._reload_one(rep, path, step, probe,
+                                            ready_timeout)
+                if ok:
+                    with self._lock:
+                        rep.state = READY
+                    done.append(rep.id)
+                    continue
+                # ---- failure: canary (or later member) — roll back
+                result = {
+                    "reloaded": False, "path": path,
+                    "failed_replica": rep.id, "canary": i == 0,
+                    "drained": drained, "error": info,
+                    "completed_before_failure": list(done),
+                }
+                to_roll = list(done)
+                if info.get("weights_changed"):
+                    to_roll.append(rep.id)
+                elif self._replica_alive(rep):
+                    # reload-stage failure kept the OLD weights: the
+                    # replica is still consistent — readmit it
+                    with self._lock:
+                        rep.state = READY
+                else:
+                    self._evict(rep, "failed during rolling reload")
+                rolled, roll_failed = self._roll_back(
+                    to_roll, rollback, rollback_step,
+                    drain_timeout, ready_timeout)
+                result["rollback_path"] = rollback
+                result["rolled_back"] = rolled
+                result["rollback_failed"] = roll_failed
+                outcome = ("rolled_back"
+                           if not roll_failed and (rolled or not to_roll)
+                           else "failed")
+                self._m_reloads[outcome].inc()
+                return result
+            self.current_checkpoint = path
+            self._m_reloads["ok"].inc()
+            return {"reloaded": True, "path": path, "step": step,
+                    "replicas": done}
+        finally:
+            self._reload_active = False
+            self._reload_lock.release()
+
+    def _roll_back(self, replica_ids: List[str],
+                   rollback: Optional[str], rollback_step: Optional[int],
+                   drain_timeout: float, ready_timeout: float
+                   ) -> Tuple[List[str], List[str]]:
+        """Reload members back onto the previously-serving checkpoint.
+        The validation probe is NOT re-run here: the rollback target
+        already served validated traffic, and a probe built to catch
+        the NEW checkpoint failing must not strand the rollback."""
+        rolled: List[str] = []
+        failed: List[str] = []
+        if rollback is None:
+            # nowhere to roll back to: members on the new checkpoint
+            # leave rotation rather than serving mixed weights
+            for rid in replica_ids:
+                with self._lock:
+                    rep = self._replicas.get(rid)
+                if rep is not None:
+                    self._evict(rep, "mixed weights, no rollback path")
+                failed.append(rid)
+            return rolled, failed
+        for rid in replica_ids:
+            with self._lock:
+                rep = self._replicas.get(rid)
+            if rep is None:
+                continue
+            with self._lock:
+                rep.state = DRAINING
+            self._drain(rep, drain_timeout)
+            ok, _ = self._reload_one(rep, rollback, rollback_step,
+                                     None, ready_timeout)
+            if ok:
+                with self._lock:
+                    rep.state = READY
+                rolled.append(rid)
+            else:
+                self._evict(rep, "rollback reload failed")
+                failed.append(rid)
+        return rolled, failed
+
+    def _replica_alive(self, rep: FleetReplica) -> bool:
+        try:
+            rep.client.healthz(timeout=self.probe_timeout)
+            return True
+        except Exception:
+            return False
+
+    # ------------------------------------------------------ autoscaling
+    def autoscale_tick(self) -> int:
+        """Apply one autoscaler decision; returns the delta applied."""
+        if self.autoscaler is None or self.spawner is None:
+            return 0
+        if self._reload_active:
+            return 0  # never resize mid-reload
+        with self._lock:
+            live = [r for r in self._replicas.values()
+                    if r.state in (READY, STARTING)]
+            outstanding = sum(r.outstanding
+                              for r in self._replicas.values())
+        delta = self.autoscaler.decide(len(live), outstanding)
+        if delta > 0:
+            self.spawn(1)
+            self.autoscaler.note_action()
+            return 1
+        if delta < 0:
+            ready = [r for r in live if r.state == READY and r.spawned]
+            if not ready:
+                return 0
+            victim = min(ready, key=lambda r: r.outstanding)
+            self.retire(victim.id)
+            self.autoscaler.note_action()
+            return -1
+        return 0
+
+    # --------------------------------------------------- observability
+    def snapshot(self) -> dict:
+        now = time.time()
+        with self._lock:
+            reps = {rid: r.snapshot(now)
+                    for rid, r in self._replicas.items()}
+        heartbeats = self.tracker.heartbeats()
+        for rid, hb in heartbeats.items():
+            if rid in reps:
+                reps[rid]["heartbeat_age_s"] = round(now - hb, 3)
+        return {
+            "replicas": reps,
+            "states": self.state_counts(),
+            "outstanding": self.total_outstanding(),
+            "shed_high_water": self.shed_high_water,
+            "current_checkpoint": self.current_checkpoint,
+            "rolling_reload_active": self._reload_active,
+            "requests": {route: int(c.value)
+                         for route, c in self._m_requests.items()},
+            "retries": int(self._m_retries.value),
+            "shed": {route: int(c.value)
+                     for route, c in self._m_shed.items()},
+            "evictions": int(self._m_evictions.value),
+            "readmissions": int(self._m_readmissions.value),
+            "reloads": {outcome: int(c.value)
+                        for outcome, c in self._m_reloads.items()},
+            "spawned": int(self._m_spawned.value),
+            "retired": int(self._m_retired.value),
+            "autoscaler": (None if self.autoscaler is None else {
+                "min_replicas": self.autoscaler.min_replicas,
+                "max_replicas": self.autoscaler.max_replicas,
+                "scale_up_at": self.autoscaler.scale_up_at,
+                "scale_down_at": self.autoscaler.scale_down_at,
+            }),
+        }

@@ -1,20 +1,32 @@
-"""Multi-replica dispatch: one engine per local device, round-robin.
+"""Multi-replica dispatch: one engine per local device, least-loaded.
 
 A single engine serializes on its device. For a host with several
 accelerator chips (or the 8-device virtual CPU mesh the tests run on),
 `ReplicaSet` clones the params onto each device as an independent
-`InferenceEngine` and round-robins requests across them — each replica
+`InferenceEngine` and dispatches requests across them — each replica
 compiles its own bucket programs once, and a shared `MicroBatcher` can
 sit in front so coalesced batches fan out over chips.
 
-This is intra-host scale-out; cross-host serving stacks the scaleout/
-runtime on top (each host runs its own replica set).
+Dispatch policy: **least outstanding requests**, with round-robin as
+the tiebreak. Blind round-robin behind a coalescing batcher is fine
+when every forward costs the same, but ragged buckets don't — a replica
+stuck on a top-bucket forward keeps receiving work it can't start. The
+set tracks per-engine in-flight counts under ONE lock; `infer`,
+`generate`, and `generate_stream` all select through the same locked
+helper (the decode-loop cursor shares the lock discipline rather than
+keeping its own), so the idle-replica-first property holds across both
+traffic classes. On a uniform idle stream the tiebreak degenerates to
+exact round-robin — the historical behavior, still pinned by tests.
+
+This is intra-host scale-out; cross-host serving stacks the fleet
+router (`serving/fleet.py`, docs/FLEET.md) on top — each host runs its
+own replica set behind one `serve_network` endpoint.
 """
 
 from __future__ import annotations
 
-import itertools
 import threading
+from contextlib import contextmanager
 from typing import List, Optional, Sequence
 
 from deeplearning4j_tpu.serving.batcher import MicroBatcher
@@ -28,9 +40,9 @@ class ReplicaSet:
         if not engines:
             raise ValueError("ReplicaSet needs at least one engine")
         self.engines: List[InferenceEngine] = list(engines)
-        self._rr = itertools.cycle(self.engines)
-        self._gen_rr = 0  # separate cursor for generate_stream dispatch
         self._lock = threading.Lock()
+        self._rr = 0  # tiebreak cursor, shared by ALL dispatch paths
+        self._outstanding = [0] * len(self.engines)
 
     @classmethod
     def for_network(cls, net, n_replicas: Optional[int] = None,
@@ -49,42 +61,76 @@ class ReplicaSet:
         return cls([InferenceEngine.for_network(net, device=d, **engine_kw)
                     for d in devices])
 
-    def _next(self) -> InferenceEngine:
+    # --------------------------------------------------------- selection
+    def _select(self, eligible: Sequence[int], load_of=None,
+                acquire: bool = False) -> int:
+        """Pick the least-loaded eligible engine index (round-robin
+        tiebreak) and advance the shared cursor. Caller holds no lock;
+        this takes the set's one lock — the single dispatch discipline
+        for every traffic class. `load_of(i)` overrides the load metric
+        (the decode path keys on live loop pressure instead of the
+        per-call outstanding counter). `acquire=True` also increments
+        the winner's outstanding count INSIDE the same critical
+        section — select-then-increment under two lock grabs would let
+        two concurrent requests pick the same idle engine."""
+        if load_of is None:
+            load_of = lambda i: self._outstanding[i]  # noqa: E731
+        n = len(self.engines)
         with self._lock:
-            return next(self._rr)
+            best = min(eligible,
+                       key=lambda i: (load_of(i), (i - self._rr) % n))
+            self._rr = (best + 1) % n
+            if acquire:
+                self._outstanding[best] += 1
+            return best
+
+    @contextmanager
+    def _checkout(self):
+        """Select an engine for one short request, holding its
+        outstanding slot for the call's duration."""
+        idx = self._select(range(len(self.engines)), acquire=True)
+        try:
+            yield self.engines[idx]
+        finally:
+            with self._lock:
+                self._outstanding[idx] -= 1
 
     # --------------------------------------------------------- dispatch
     def infer(self, x):
-        return self._next().infer(x)
+        with self._checkout() as engine:
+            return engine.infer(x)
 
     def generate(self, prompt, n_tokens: int):
-        """Per-request compiled-scan decode on the next replica (the
-        legacy path; concurrent generate traffic belongs on
+        """Per-request compiled-scan decode on the least-loaded replica
+        (the legacy path; concurrent generate traffic belongs on
         `generate_stream` — the slot scheduler is its own batcher)."""
-        return self._next().generate(prompt, n_tokens)
+        with self._checkout() as engine:
+            return engine.generate(prompt, n_tokens)
 
     def generate_stream(self, prompt, max_tokens: int, eos_id=None):
         """Submit one prompt to a replica's continuous-batching decode
-        loop (round-robin over the replicas that run one). Each loop
-        slot-schedules its own streams, so this fans concurrent
-        generate traffic across chips without coalescing delays."""
-        with self._lock:
-            loops = [e for e in self.engines if e.decode_loop is not None]
-            if not loops:
-                raise ValueError(
-                    "no replica runs a decode loop (construct engines "
-                    "with decode_slots= or call start_decode_loop)")
-            engine = loops[self._gen_rr % len(loops)]
-            self._gen_rr += 1
-        return engine.generate_stream(prompt, max_tokens, eos_id)
+        loop: least loop pressure (queued + occupied slots) wins, with
+        the same shared round-robin cursor as `infer` breaking ties —
+        so concurrent generate traffic fans across chips toward the
+        idlest loop, without coalescing delays."""
+        loops = [i for i, e in enumerate(self.engines)
+                 if e.decode_loop is not None]
+        if not loops:
+            raise ValueError(
+                "no replica runs a decode loop (construct engines "
+                "with decode_slots= or call start_decode_loop)")
+        idx = self._select(
+            loops, load_of=lambda i: self.engines[i].decode_loop.load)
+        return self.engines[idx].generate_stream(prompt, max_tokens,
+                                                 eos_id)
 
     def warmup(self, feature_shape, **kw) -> None:
         for engine in self.engines:
             engine.warmup(feature_shape, **kw)
 
     def batcher(self, **kw) -> MicroBatcher:
-        """A shared micro-batcher whose coalesced batches round-robin
-        over the replicas."""
+        """A shared micro-batcher whose coalesced batches fan out over
+        the replicas (least-outstanding first)."""
         return MicroBatcher(self.infer, **kw)
 
     # --------------------------------------------------------- hot reload
@@ -129,6 +175,11 @@ class ReplicaSet:
         sizes = [e.program_cache_size() for e in self.engines]
         return -1 if any(s < 0 for s in sizes) else sum(sizes)
 
+    def outstanding(self) -> List[int]:
+        """Per-engine in-flight request counts (a point-in-time copy)."""
+        with self._lock:
+            return list(self._outstanding)
+
     def snapshot(self) -> dict:
         reps = [e.snapshot() for e in self.engines]
         buckets: dict = {}
@@ -140,6 +191,7 @@ class ReplicaSet:
             "requests": sum(r["requests"] for r in reps),
             "rows": sum(r["rows"] for r in reps),
             "errors": sum(r["errors"] for r in reps),
+            "outstanding": self.outstanding(),
             "compiled_programs": self.program_cache_size(),
             # aggregated per-bucket forward counts across replicas
             "bucket_forwards": {str(b): buckets[b]

@@ -1,0 +1,392 @@
+"""Fleet router tier: the HTTP front end over out-of-process replicas.
+
+Two pieces live here (the fleet state machine itself is
+`serving/fleet.py`):
+
+- `ReplicaClient` — a thin stdlib HTTP client for ONE replica serving
+  endpoint (`serve_network`'s surface: /predict, /generate, /reload,
+  /healthz, /readyz, /stats). One connection per call: the router's
+  concurrency comes from its own handler threads, and a fresh
+  connection per request means a dead replica fails THIS call with a
+  clean OSError instead of poisoning a pooled socket.
+- `serve_fleet(fleet)` — the router's own HTTP server (same
+  utils/httpd.py lifecycle as every embedded server in the repo):
+
+  - ``POST /predict``  — least-outstanding ready replica; connection
+    failures and replica 5xx retry transparently on a healthy peer
+    (idempotent, so at-least-once is safe); total-outstanding past the
+    fleet's high-water mark sheds with 503 + Retry-After.
+  - ``POST /generate`` — one ready replica, streamed straight through
+    (chunked NDJSON passthrough). NOT retried: a generate is expensive
+    and the stream may already be partially delivered — failures
+    before the first byte answer 502 with a structured
+    ``{"error": "replica_failed", "replica": ..., "retryable": true}``;
+    failures mid-stream emit the same error object in-band as the
+    final NDJSON line.
+  - ``POST /reload``   — rolling/canary reload across the fleet
+    (drain -> per-replica /reload -> /readyz probe -> readmit, one at
+    a time; automatic rollback when the canary fails — Fleet.rolling_reload).
+  - ``POST /scale``    — autoscaling hook: ``{"replicas": N}`` spawns
+    or retires to N (requires a spawner).
+  - ``GET /healthz``   — router liveness + per-state replica counts.
+  - ``GET /readyz``    — 200 iff at least one replica is ready.
+  - ``GET /stats``     — Fleet.snapshot().
+  - ``GET /metrics`` / ``/snapshot`` — the router process's telemetry
+    registry: the `dl4j_fleet_*` series (docs/OBSERVABILITY.md).
+
+Every reply slurps the POST body first (HTTP/1.1 keep-alive would
+desync otherwise — the same lesson serving/server.py carries).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler
+from typing import Optional, Tuple
+
+from deeplearning4j_tpu.serving.errors import OverloadedError, overload_body
+from deeplearning4j_tpu.telemetry import exposition
+from deeplearning4j_tpu.utils.httpd import ServerHandle, start_http_server
+
+__all__ = ["ReplicaClient", "FleetHandle", "serve_fleet"]
+
+
+class ReplicaClient:
+    """Stdlib HTTP client for one replica serving endpoint."""
+
+    def __init__(self, url: str, timeout: float = 30.0):
+        if "//" not in url:
+            url = "http://" + url
+        parsed = urllib.parse.urlsplit(url)
+        if parsed.hostname is None or parsed.port is None:
+            raise ValueError(
+                f"replica url needs host:port, got {url!r}")
+        self.host = parsed.hostname
+        self.port = int(parsed.port)
+        self.url = f"http://{self.host}:{self.port}"
+        self.timeout = float(timeout)
+
+    # ------------------------------------------------------------- raw
+    def open(self, method: str, path: str, body: Optional[bytes] = None,
+             timeout: Optional[float] = None):
+        """Issue a request and return (connection, response) with the
+        body NOT yet read — the streaming proxy relays it chunk by
+        chunk. The caller owns `connection.close()`."""
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            self.host, self.port,
+            timeout=self.timeout if timeout is None else timeout)
+        headers = {"Content-Type": "application/json"} if body else {}
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+        except BaseException:
+            conn.close()
+            raise
+        return conn, resp
+
+    def request(self, method: str, path: str,
+                body: Optional[bytes] = None,
+                timeout: Optional[float] = None
+                ) -> Tuple[int, dict, bytes]:
+        """One whole request: (status, headers-dict, body-bytes)."""
+        conn, resp = self.open(method, path, body, timeout)
+        try:
+            data = resp.read()
+            return resp.status, dict(resp.getheaders()), data
+        finally:
+            conn.close()
+
+    # ------------------------------------------------------ conveniences
+    def get_json(self, path: str, timeout: Optional[float] = None
+                 ) -> Tuple[int, dict]:
+        status, _, data = self.request("GET", path, timeout=timeout)
+        try:
+            payload = json.loads(data) if data else {}
+        except ValueError:
+            payload = {"raw": data.decode(errors="replace")}
+        return status, payload
+
+    def healthz(self, timeout: Optional[float] = None) -> dict:
+        """Liveness probe; raises on connection failure or non-200."""
+        status, payload = self.get_json("/healthz", timeout)
+        if status != 200:
+            raise RuntimeError(f"healthz answered {status}")
+        return payload
+
+    def readyz(self, timeout: Optional[float] = None
+               ) -> Tuple[bool, dict]:
+        """Readiness probe: (ready, payload). Connection failures
+        propagate (the caller distinguishes dead from not-ready)."""
+        status, payload = self.get_json("/readyz", timeout)
+        return status == 200, payload
+
+    def stats(self, timeout: Optional[float] = None) -> dict:
+        status, payload = self.get_json("/stats", timeout)
+        if status != 200:
+            raise RuntimeError(f"stats answered {status}")
+        return payload
+
+
+class FleetHandle:
+    """A running fleet router: http handle + the fleet behind it."""
+
+    def __init__(self, fleet, http: Optional[ServerHandle] = None):
+        self.fleet = fleet
+        self.http = http
+        self.started_at = time.time()
+
+    @property
+    def url(self) -> str:
+        return self.http.url
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    def close(self, stop_replicas: bool = False) -> None:
+        """Stop routing, then stop the fleet's control plane (and the
+        spawned replica processes too when `stop_replicas`)."""
+        self.http.close()
+        self.fleet.close(stop_replicas=stop_replicas)
+
+    def __enter__(self) -> "FleetHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # mirror Fleet.__exit__: spawned replica processes die with the
+        # context (attached-by-URL replicas are never touched)
+        self.close(stop_replicas=True)
+
+
+def serve_fleet(fleet, host: str = "127.0.0.1",
+                port: int = 0) -> FleetHandle:
+    """Start the router HTTP tier over a (started) Fleet."""
+    from deeplearning4j_tpu.serving.fleet import NoReadyReplicas
+
+    handle = FleetHandle(fleet)
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"  # streaming passthrough needs it
+
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _reply(self, code: int, payload: dict,
+                   extra_headers=()) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            for k, v in extra_headers:
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_raw(self, code: int, ctype: str, body: bytes) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_overloaded(self, e: OverloadedError) -> None:
+            self._reply(503, overload_body(e),
+                        extra_headers=[("Retry-After",
+                                        str(e.retry_after_s))])
+
+        # ----------------------------------------------------- routes
+        def do_GET(self):
+            try:
+                if self.path.startswith("/healthz"):
+                    self._reply(200, {"ok": True,
+                                      "replicas": fleet.state_counts()})
+                elif self.path.startswith("/readyz"):
+                    n = fleet.ready_count()
+                    self._reply(200 if n else 503,
+                                {"ready": n > 0, "ready_replicas": n})
+                elif self.path.startswith("/stats"):
+                    self._reply(200, {
+                        "uptime_s": round(
+                            time.time() - handle.started_at, 3),
+                        "fleet": fleet.snapshot()})
+                elif (hit := exposition.handle_metrics_get(
+                        self.path)) is not None:
+                    self._reply_raw(*hit)
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+            except Exception as e:  # always answer with a status line
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def do_POST(self):
+            # slurp the body BEFORE any reply (keep-alive framing)
+            length = int(self.headers.get("Content-Length") or 0)
+            self._body = self.rfile.read(length) if length > 0 else None
+            try:
+                if self.path.startswith("/predict"):
+                    self._predict()
+                elif self.path.startswith("/generate"):
+                    self._generate()
+                elif self.path.startswith("/reload"):
+                    self._reload()
+                elif self.path.startswith("/scale"):
+                    self._scale()
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+            except OverloadedError as e:
+                self._reply_overloaded(e)
+            except NoReadyReplicas as e:
+                self._reply(503, {"error": "no_ready_replicas",
+                                  "detail": str(e)},
+                            extra_headers=[("Retry-After", "1")])
+            except (ValueError, KeyError, TypeError) as e:
+                self._reply(400, {"error": str(e)})
+            except Exception as e:
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _read_json(self) -> dict:
+            if self._body is None:
+                raise ValueError("missing request body")
+            data = json.loads(self._body)
+            if not isinstance(data, dict):
+                raise ValueError("request body must be a JSON object")
+            return data
+
+        def _predict(self):
+            if self._body is None:
+                raise ValueError("missing request body")
+            status, headers, data = fleet.forward_predict(self._body)
+            ctype = headers.get("Content-Type", "application/json")
+            extra = [("Retry-After", headers["Retry-After"])] \
+                if "Retry-After" in headers else []
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            for k, v in extra:
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _generate(self):
+            data = self._read_json()  # parsed only for the stream flag
+            streaming = bool(data.get("stream", False))
+            replica = fleet.select(route="generate")
+            start = time.perf_counter()
+            import http.client as _hc
+
+            replica_errs = (OSError, _hc.HTTPException)
+            try:
+                try:
+                    conn, resp = replica.client.open(
+                        "POST", "/generate", self._body,
+                        timeout=fleet.generate_timeout)
+                except replica_errs as e:
+                    # failed before any byte reached the client: fail
+                    # FAST with a structured, retryable error (the
+                    # router never replays a generate itself)
+                    fleet.note_request_failure(replica, e)
+                    self._reply(502, {
+                        "error": "replica_failed",
+                        "replica": replica.id,
+                        "detail": f"{type(e).__name__}: {e}",
+                        "retryable": True})
+                    return
+                try:
+                    if streaming and resp.status == 200:
+                        self._relay_stream(replica, resp)
+                        return
+                    try:
+                        body = resp.read()
+                    except replica_errs as e:
+                        # replica died mid-body; the client has seen
+                        # nothing yet, so the structured 502 still fits
+                        fleet.note_request_failure(replica, e)
+                        self._reply(502, {
+                            "error": "replica_failed",
+                            "replica": replica.id,
+                            "detail": f"{type(e).__name__}: {e}",
+                            "retryable": True})
+                        return
+                    extra = []
+                    ra = resp.getheader("Retry-After")
+                    if ra:
+                        extra.append(("Retry-After", ra))
+                    ctype = resp.getheader("Content-Type",
+                                           "application/json")
+                    self.send_response(resp.status)
+                    self.send_header("Content-Type", ctype)
+                    for k, v in extra:
+                        self.send_header(k, v)
+                    self.send_header("Content-Length",
+                                     str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                finally:
+                    conn.close()
+            finally:
+                fleet.release(replica)
+                fleet.observe("generate", time.perf_counter() - start)
+
+        def _relay_stream(self, replica, resp) -> None:
+            """Chunked NDJSON passthrough; a mid-stream replica failure
+            is reported in-band (headers are long gone). Replica reads
+            and client writes fail SEPARATELY: only a replica-side
+            failure is attributed to the replica — a client hanging up
+            must never evict a healthy replica."""
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             resp.getheader("Content-Type",
+                                            "application/x-ndjson"))
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(raw: bytes) -> None:
+                self.wfile.write(f"{len(raw):x}\r\n".encode()
+                                 + raw + b"\r\n")
+                self.wfile.flush()
+
+            try:
+                while True:
+                    try:
+                        piece = resp.readline()  # http.client de-chunks
+                    except Exception as e:  # replica died mid-stream
+                        fleet.note_request_failure(replica, e)
+                        chunk((json.dumps({
+                            "error": "replica_failed",
+                            "replica": replica.id,
+                            "detail": f"{type(e).__name__}: {e}"})
+                            + "\n").encode())
+                        break
+                    if not piece:
+                        break
+                    chunk(piece)
+                self.wfile.write(b"0\r\n\r\n")
+            except Exception:  # client hung up: nothing left to tell it
+                pass
+            self.close_connection = True
+
+        def _reload(self):
+            data = self._read_json()
+            path = data.get("path")
+            if not path:
+                raise ValueError("reload needs {'path': <checkpoint>}")
+            step = data.get("step")
+            result = fleet.rolling_reload(
+                str(path), step=None if step is None else int(step),
+                rollback_path=data.get("rollback_path"),
+                probe=data.get("probe"))
+            self._reply(200 if result.get("reloaded") else 409, result)
+
+        def _scale(self):
+            data = self._read_json()
+            n = data.get("replicas")
+            if not isinstance(n, int) or n < 0:
+                raise ValueError("scale needs {'replicas': N >= 0}")
+            result = fleet.scale_to(n)
+            self._reply(200, result)
+
+    handle.http = start_http_server(Handler, host=host, port=port)
+    return handle

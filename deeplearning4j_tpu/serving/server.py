@@ -23,22 +23,37 @@ thread, port-0 auto-assign, graceful close. Endpoints:
   (docs/CHECKPOINTS.md) WITHOUT dropping in-flight requests: each
   engine validates shapes, stages the new params on its device, then
   swaps by a single reference assignment.
-- ``GET /healthz``   liveness + replica count.
+- ``GET /healthz``   liveness + replica count. Liveness ONLY — a
+  process that is up but still compiling answers 200 here.
+- ``GET /readyz``    readiness: 503 until the warmup precompile has
+  finished (sync warmup is done before the socket opens; with
+  ``warmup_async=True`` the socket opens immediately and this flips
+  when the background warmup lands) and, when a decode loop runs, its
+  scheduler thread is alive. The fleet router (serving/fleet.py) and
+  any external LB gate admission on this, never on /healthz.
 - ``GET /stats``     replica + batcher (queue depth, per-bucket forward
   counts) + uptime counters + last reload.
 - ``GET /metrics``   Prometheus text exposition of the process-global
   telemetry registry (train/serve/guardian/device series —
   docs/OBSERVABILITY.md); ``GET /snapshot`` is the JSON twin.
 
+Overload is machine-actionable end to end: a full batcher queue
+(`max_queue=`) or a saturated decode admission queue (`max_waiting=`)
+answers ``503`` with a ``Retry-After`` header and
+``{"error": "overloaded", "retry_after_ms": N}`` — the shape the fleet
+router's shedding also speaks (serving/errors.py, docs/FLEET.md).
+
 This front end is deliberately minimal (stdlib only, JSON in/out, one
-process): production fronting (TLS, auth, load shedding) belongs in the
-infra layer; the contract that matters here is that everything behind
-the socket is already batched, bucketed, and compiled once per shape.
+process): production fronting (TLS, auth, fleet-level routing/shedding)
+belongs to the router tier (serving/fleet.py) or external infra; the
+contract that matters here is that everything behind the socket is
+already batched, bucketed, and compiled once per shape.
 """
 
 from __future__ import annotations
 
 import json
+import threading
 import time
 from concurrent.futures import Future
 from http.server import BaseHTTPRequestHandler
@@ -48,6 +63,7 @@ import numpy as np
 
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.serving.engine import InferenceEngine
+from deeplearning4j_tpu.serving.errors import OverloadedError, overload_body
 from deeplearning4j_tpu.serving.replicas import ReplicaSet
 from deeplearning4j_tpu.telemetry import exposition
 from deeplearning4j_tpu.utils.httpd import ServerHandle, start_http_server
@@ -72,13 +88,19 @@ class ServingHandle:
 
     def __init__(self, replicas: ReplicaSet, batcher,
                  generate_engine: Optional[InferenceEngine],
-                 http: Optional[ServerHandle] = None):
+                 http: Optional[ServerHandle] = None,
+                 warmup_pending: bool = False):
         self.http = http
         self.replicas = replicas
         self.batcher = batcher
         self.generate_engine = generate_engine
         self.started_at = time.time()
         self.last_reload: Optional[dict] = None
+        # readiness state: pre-set unless an async warmup is in flight
+        self._warmed = threading.Event()
+        self.warmup_error: Optional[str] = None
+        if not warmup_pending:
+            self._warmed.set()
 
     @property
     def url(self) -> str:
@@ -102,6 +124,43 @@ class ServingHandle:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+    # ----------------------------------------------------- readiness
+    def _run_warmup(self, feature_shape) -> None:
+        """Background warmup (`warmup_async=True`): the socket is
+        already accepting — /healthz answers, /readyz gates admission
+        until every bucket program is compiled."""
+        try:
+            self.replicas.warmup(tuple(feature_shape))
+        except Exception as e:  # surface via /readyz, don't die silent
+            self.warmup_error = f"{type(e).__name__}: {e}"
+        finally:
+            self._warmed.set()
+
+    def readiness(self) -> dict:
+        """Readiness probe payload: ready iff warmup precompile is done
+        (and didn't fail) and, if a decode loop runs, its scheduler
+        thread is alive. `/readyz` (and the fleet router) keys on
+        this; liveness stays on /healthz."""
+        reasons = []
+        if not self._warmed.is_set():
+            reasons.append("warmup in progress")
+        elif self.warmup_error is not None:
+            reasons.append(f"warmup failed: {self.warmup_error}")
+        loop = (self.generate_engine.decode_loop
+                if self.generate_engine is not None else None)
+        if loop is not None and not loop.alive:
+            reasons.append("decode loop not running")
+        if self.batcher is not None and not self.batcher._worker.is_alive():
+            reasons.append("batcher worker not running")
+        out = {"ready": not reasons,
+               "warmup_done": self._warmed.is_set(),
+               "replicas": len(self.replicas.engines)}
+        if loop is not None:
+            out["decode_loop_alive"] = loop.alive
+        if reasons:
+            out["reason"] = "; ".join(reasons)
+        return out
 
     def stats(self) -> dict:
         out = {"uptime_s": round(time.time() - self.started_at, 3),
@@ -133,10 +192,13 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                   generate_engine: Optional[InferenceEngine] = None,
                   n_replicas: Optional[int] = None,
                   max_batch_size: int = 64, max_delay_ms: float = 2.0,
+                  max_queue: Optional[int] = None,
                   slots: int = 8, page_size: int = 16,
                   kv_pages: Optional[int] = None,
+                  max_waiting: Optional[int] = None,
                   host: str = "127.0.0.1", port: int = 0,
-                  warmup_shape=None) -> ServingHandle:
+                  warmup_shape=None,
+                  warmup_async: bool = False) -> ServingHandle:
     """Serve a MultiLayerNetwork (or a prebuilt ReplicaSet) over HTTP.
 
     Pass `net` for the common case — a replica set is built across
@@ -148,24 +210,34 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
     streams over a paged KV pool of `kv_pages` pages of `page_size`
     tokens — docs/SERVING.md tuning notes). `warmup_shape` (one
     example's feature shape) precompiles every bucket before the socket
-    opens.
+    opens; `warmup_async=True` opens the socket first and runs the
+    warmup on a background thread, with `/readyz` answering 503 until
+    it lands (how a fleet replica hides its spin-up cost behind the
+    router, docs/FLEET.md). `max_queue` bounds the /predict coalescing
+    queue and `max_waiting` the /generate admission queue — past
+    either, requests shed with 503 + Retry-After.
     """
     if replicas is None:
         if net is None:
             raise ValueError("serve_network needs net= or replicas=")
         replicas = ReplicaSet.for_network(net, n_replicas=n_replicas,
                                           max_batch_size=max_batch_size)
-    if warmup_shape is not None:
-        replicas.warmup(tuple(warmup_shape))
+    warm = tuple(warmup_shape) if warmup_shape is not None else None
+    if warm is not None and not warmup_async:
+        replicas.warmup(warm)
     # slots=0 opts out of continuous batching: /generate falls back to
     # the per-request compiled-scan path (no streaming/EOS)
     if (generate_engine is not None and slots
             and generate_engine.decode_loop is None):
         generate_engine.start_decode_loop(slots=slots, page_size=page_size,
-                                          n_pages=kv_pages)
+                                          n_pages=kv_pages,
+                                          max_waiting=max_waiting)
     batcher = replicas.batcher(max_batch_size=max_batch_size,
-                               max_delay_ms=max_delay_ms)
-    handle = ServingHandle(replicas, batcher, generate_engine)
+                               max_delay_ms=max_delay_ms,
+                               max_queue=max_queue)
+    handle = ServingHandle(replicas, batcher, generate_engine,
+                           warmup_pending=(warm is not None
+                                           and warmup_async))
 
     class Handler(BaseHTTPRequestHandler):
         # chunked transfer (the streaming /generate response) needs
@@ -201,6 +273,9 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                 if self.path.startswith("/healthz"):
                     self._reply(200, {"ok": True,
                                       "replicas": len(replicas.engines)})
+                elif self.path.startswith("/readyz"):
+                    ready = handle.readiness()
+                    self._reply(200 if ready["ready"] else 503, ready)
                 elif self.path.startswith("/stats"):
                     self._reply(200, handle.stats())
                 elif (hit := exposition.handle_metrics_get(
@@ -229,6 +304,16 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                     self._reply(404, {"error": f"no route {self.path}"})
             except FileNotFoundError as e:
                 self._reply(404, {"error": str(e)})
+            except OverloadedError as e:
+                # machine-actionable shedding: 503 + Retry-After +
+                # JSON body, same shape as the fleet router's shed
+                self.send_response(503)
+                self.send_header("Retry-After", str(e.retry_after_s))
+                body = json.dumps(overload_body(e)).encode()
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             except (ValueError, KeyError, TypeError) as e:
                 self._reply(400, {"error": str(e)})
             except Exception as e:  # engine-side failure
@@ -295,13 +380,11 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                                                max_tokens)
                 self._reply(200, {"tokens": out.astype(int).tolist()})
                 return
-            # validate EVERY row before submitting any: a malformed row
-            # must 400 the request without orphaning its row-mates'
-            # streams in running slots
-            for row in prompt:
-                loop.validate(row, max_tokens)
-            streams = [loop.submit(row, max_tokens, eos_id)
-                       for row in prompt]
+            # all-or-nothing admission: a malformed row 400s and an
+            # admission shed 503s WITHOUT orphaning row-mates' streams
+            # in running slots (submit_many validates every row, then
+            # enqueues the whole group under one lock)
+            streams = loop.submit_many(prompt, max_tokens, eos_id)
             if streaming:
                 self._stream_tokens(streams)
                 return
@@ -371,4 +454,7 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                    "finish_reasons": [s.finish_reason for s in streams]})
 
     handle.http = start_http_server(Handler, host=host, port=port)
+    if warm is not None and warmup_async:
+        threading.Thread(target=handle._run_warmup, args=(warm,),
+                         daemon=True, name="serve-warmup").start()
     return handle

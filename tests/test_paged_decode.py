@@ -407,3 +407,42 @@ class TestConcurrentSubmitters:
             for t in threads:
                 t.join()
         assert results == refs
+
+
+# ------------------------------------------------ admission shedding
+class TestAdmissionShedding:
+    def test_max_waiting_sheds_only_when_not_immediately_admittable(self):
+        """ISSUE 7 satellite: with `max_waiting` set, a submit that
+        cannot start right now (no free slot / pages) while the
+        admission queue is at its bound raises OverloadedError — but a
+        request that COULD start immediately is never shed."""
+        from deeplearning4j_tpu.serving.errors import OverloadedError
+
+        p = _params()
+        # start=False: no scheduler thread, so nothing is admitted and
+        # the queue state is fully deterministic
+        loop = DecodeLoop(p, CFG, slots=1, page_size=8, max_waiting=0,
+                          start=False)
+        first = loop.submit([1, 2, 3], 4)  # admittable now -> queued
+        assert first is not None
+        with pytest.raises(OverloadedError) as e:
+            loop.submit([4, 5], 3)  # queue occupied, bound is 0
+        assert e.value.retry_after_ms > 0
+        assert loop.snapshot()["shed"] == 1
+        # drain the queued request; the loop accepts again after
+        loop.run_until_idle()
+        assert first.done
+        second = loop.submit([4, 5], 3)
+        loop.run_until_idle()
+        assert second.done
+        loop.close()
+
+    def test_validation_errors_stay_400_shaped(self):
+        """Permanent failures (prompt can never fit) are ValueError,
+        not OverloadedError — a client must not retry them."""
+        p = _params()
+        loop = DecodeLoop(p, CFG, slots=1, page_size=8, n_pages=2,
+                          max_waiting=4, start=False)
+        with pytest.raises(ValueError, match="pages"):
+            loop.submit(list(range(40)), 4)
+        loop.close()

@@ -357,3 +357,83 @@ class TestReplicaSet:
             ReplicaSet([])
         with pytest.raises(ValueError, match="n_replicas"):
             ReplicaSet.for_network(_net(), n_replicas=0)
+
+    def test_least_outstanding_avoids_busy_replica(self):
+        """ISSUE 7 satellite: a replica stuck in a long forward stops
+        receiving traffic — concurrent requests route to the idle one
+        (blind round-robin would keep feeding the stuck replica)."""
+        import threading
+
+        gate = threading.Event()
+
+        class StubEngine:
+            decode_loop = None
+
+            def __init__(self, block=False):
+                self.block = block
+                self.served = 0
+
+            def infer(self, x):
+                self.served += 1
+                if self.block:
+                    assert gate.wait(30)
+                return np.asarray(x)
+
+        slow, fast = StubEngine(block=True), StubEngine()
+        reps = ReplicaSet([slow, fast])
+        try:
+            blocked = threading.Thread(
+                target=reps.infer, args=(np.zeros((1, 2), np.float32),),
+                daemon=True)
+            blocked.start()
+            deadline = 30.0
+            import time
+            t0 = time.monotonic()
+            while slow.served == 0:  # the blocked call reached `slow`
+                assert time.monotonic() - t0 < deadline
+                time.sleep(0.005)
+            assert reps.outstanding() == [1, 0]
+            for _ in range(4):  # all concurrent traffic avoids it
+                reps.infer(np.zeros((1, 2), np.float32))
+            assert fast.served == 4 and slow.served == 1
+        finally:
+            gate.set()
+            blocked.join(timeout=30)
+        # back to idle: the tiebreak degenerates to round-robin
+        assert reps.outstanding() == [0, 0]
+        for _ in range(4):
+            reps.infer(np.zeros((1, 2), np.float32))
+        assert slow.served == 3 and fast.served == 6
+
+    def test_generate_stream_prefers_least_loaded_loop(self):
+        """The generate_stream cursor rides the same locked selector:
+        dispatch keys on live loop pressure (queued + occupied)."""
+
+        class StubLoop:
+            def __init__(self, load):
+                self.load = load
+
+        class StubEngine:
+            def __init__(self, loop):
+                self.decode_loop = loop
+                self.streams = 0
+
+            def generate_stream(self, prompt, max_tokens, eos_id=None):
+                self.streams += 1
+                return f"stream-{id(self)}"
+
+        busy = StubEngine(StubLoop(load=3))
+        idle = StubEngine(StubLoop(load=0))
+        plain = StubEngine(None)  # no decode loop: never eligible
+        reps = ReplicaSet([busy, plain, idle])
+        for _ in range(3):
+            reps.generate_stream([1, 2], 4)
+        assert idle.streams == 3 and busy.streams == 0
+        assert plain.streams == 0
+        # equal pressure -> round-robin over the loop-bearing engines
+        idle.decode_loop.load = 3
+        for _ in range(4):
+            reps.generate_stream([1, 2], 4)
+        assert busy.streams == 2 and idle.streams == 5
+        with pytest.raises(ValueError, match="decode loop"):
+            ReplicaSet([plain]).generate_stream([1], 2)

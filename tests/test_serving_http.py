@@ -325,6 +325,169 @@ class TestHTTPRoundTrip:
             assert e.value.code == 404
 
 
+class TestReadiness:
+    """ISSUE 7 satellite: /healthz stays liveness; /readyz gates on
+    warmup completion and decode-loop health."""
+
+    def test_async_warmup_gates_readyz(self):
+        import threading
+
+        from deeplearning4j_tpu.serving import ReplicaSet
+
+        net = _net()
+        rs = ReplicaSet.for_network(net, n_replicas=1, max_batch_size=16)
+        gate = threading.Event()
+        inner_warmup = rs.warmup
+
+        def gated_warmup(shape, **kw):
+            assert gate.wait(30)
+            inner_warmup(shape, **kw)
+
+        rs.warmup = gated_warmup
+        handle = serve_network(replicas=rs, max_delay_ms=1.0,
+                               warmup_shape=(4,), warmup_async=True)
+        try:
+            # alive immediately, NOT ready until the warmup lands
+            assert _get(f"{handle.url}/healthz")["ok"]
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(f"{handle.url}/readyz")
+            assert e.value.code == 503
+            body = json.loads(e.value.read())
+            assert body["ready"] is False
+            assert "warmup" in body["reason"]
+            gate.set()
+            deadline = 30
+            import time
+            t0 = time.monotonic()
+            while True:
+                try:
+                    ready = _get(f"{handle.url}/readyz")
+                    break
+                except urllib.error.HTTPError:
+                    assert time.monotonic() - t0 < deadline
+                    time.sleep(0.05)
+            assert ready["ready"] and ready["warmup_done"]
+            assert rs.engines[0].warmed_up
+        finally:
+            gate.set()
+            handle.close()
+
+    def test_sync_warmup_is_ready_from_first_connection(self):
+        with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           warmup_shape=(4,)) as handle:
+            assert _get(f"{handle.url}/readyz")["ready"] is True
+
+    def test_dead_decode_loop_flips_readyz(self):
+        params = init_transformer_params(jax.random.PRNGKey(0), CFG)
+        gen = InferenceEngine.for_transformer(params, CFG)
+        with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           generate_engine=gen, slots=2,
+                           page_size=8) as handle:
+            assert _get(f"{handle.url}/readyz")["decode_loop_alive"]
+            gen.decode_loop.close()  # the loop dies under the server
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(f"{handle.url}/readyz")
+            assert e.value.code == 503
+            body = json.loads(e.value.read())
+            assert "decode loop" in body["reason"]
+            # liveness is unaffected — the split is the point
+            assert _get(f"{handle.url}/healthz")["ok"]
+
+
+class TestOverloadShedding:
+    """ISSUE 7 satellite: saturation answers 503 + Retry-After +
+    {"error": "overloaded", "retry_after_ms": N} — machine-actionable
+    end to end, on both /predict (batcher queue) and /generate
+    (decode admission queue)."""
+
+    def test_predict_queue_full_sheds_503_with_retry_after(self):
+        import threading
+
+        from deeplearning4j_tpu.serving import ReplicaSet
+
+        gate = threading.Event()
+
+        class GatedEngine:
+            """Duck-typed engine: blocks until released."""
+
+            decode_loop = None
+
+            def infer(self, x):
+                assert gate.wait(30)
+                return np.zeros((x.shape[0], 3), np.float32)
+
+            def snapshot(self):
+                return {"requests": 0, "rows": 0, "errors": 0}
+
+            def program_cache_size(self):
+                return 0
+
+        handle = serve_network(replicas=ReplicaSet([GatedEngine()]),
+                               max_delay_ms=1.0, max_queue=1)
+        try:
+            results = []
+
+            def post_bg():
+                try:
+                    results.append(_post(f"{handle.url}/predict",
+                                         {"inputs": [[1.0, 2.0]]}))
+                except Exception as e:  # noqa: BLE001
+                    results.append(e)
+
+            # request 1 occupies the engine; request 2 fills the queue
+            threads = [threading.Thread(target=post_bg, daemon=True)
+                       for _ in range(2)]
+            threads[0].start()
+            import time
+            time.sleep(0.3)  # worker has dequeued req 1 into the engine
+            threads[1].start()
+            time.sleep(0.3)  # req 2 is parked in the queue
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(f"{handle.url}/predict", {"inputs": [[1.0, 2.0]]})
+            assert e.value.code == 503
+            assert int(e.value.headers["Retry-After"]) >= 1
+            body = json.loads(e.value.read())
+            assert body["error"] == "overloaded"
+            assert body["retry_after_ms"] > 0
+            gate.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert all(isinstance(r, dict) for r in results)
+            assert handle.batcher.snapshot()["shed"] == 1
+        finally:
+            gate.set()
+            handle.close()
+
+    def test_generate_admission_full_sheds_503(self):
+        params = init_transformer_params(jax.random.PRNGKey(0), CFG)
+        gen = InferenceEngine.for_transformer(params, CFG)
+        with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           generate_engine=gen, slots=1, page_size=8,
+                           max_waiting=0) as handle:
+            assert gen.decode_loop.max_waiting == 0
+            # request 1 occupies the single slot for ~max_len tokens;
+            # reading its first streamed token proves it holds the slot
+            req = urllib.request.Request(
+                f"{handle.url}/generate",
+                data=json.dumps({"prompt": [[1, 2, 3, 4]],
+                                 "max_tokens": 48,
+                                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            r = urllib.request.urlopen(req, timeout=60)
+            first = json.loads(r.readline())
+            assert "token" in first
+            # slot busy + max_waiting=0 -> the second request sheds
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(f"{handle.url}/generate",
+                      {"prompt": [[5, 6]], "max_tokens": 2})
+            assert e.value.code == 503
+            assert int(e.value.headers["Retry-After"]) >= 1
+            body = json.loads(e.value.read())
+            assert body["error"] == "overloaded"
+            r.close()
+            assert gen.decode_loop.snapshot()["shed"] == 1
+
+
 class TestHotReload:
     """ISSUE satellite: POST /reload hot-swaps replica weights from a
     checkpoint path without dropping in-flight requests."""
