@@ -817,6 +817,171 @@ def bench_fleet():
             "threads": n_threads, "rows_per_request": rows}
 
 
+def bench_chaos():
+    """Chaos availability drill (ISSUE 8, docs/FLEET.md "Chaos
+    runbook"): SIGSTOP one of two replica processes mid-hammer — hung,
+    NOT dead: the kernel keeps accepting connections into the listen
+    backlog, so connection-failure eviction never fires and only the
+    request path stalls. Every client request carries an
+    `X-Deadline-Ms` budget. Gates: ZERO client-visible failures within
+    those budgets (per-hop deadline-derived timeouts + retries on the
+    healthy peer absorb every stall), the circuit breaker evicts the
+    hung member within 2x its detection window (breaker_threshold x
+    request_timeout + breaker_reset_s — the heartbeat path cannot see
+    this failure mode), bounded p99 degradation, and SIGCONT leads to
+    half-open `/readyz` readmission."""
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
+    from deeplearning4j_tpu.serving.fleet import (Fleet, ReplicaSpawner,
+                                                  EVICTED, READY)
+    from deeplearning4j_tpu.serving.router import serve_fleet
+    from deeplearning4j_tpu.testing import chaos as chaos_mod
+
+    fast = _fast()
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(16).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([32])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=4)
+            .pretrain(False).build())
+    work = tempfile.mkdtemp(prefix="dl4j_bench_chaos_")
+    ckpt = os.path.join(work, "chaos.ckpt")
+    DefaultModelSaver(ckpt, keep_old=False).save(MultiLayerNetwork(conf))
+    spawner = ReplicaSpawner(ckpt, serve_args=["--max-delay-ms", "1"])
+
+    rows = 4
+    deadline_ms = 20_000
+    body = _json.dumps(
+        {"inputs": np.random.RandomState(0).rand(rows, 16).tolist()}
+    ).encode()
+    request_timeout, breaker_threshold, breaker_reset_s = 0.5, 2, 0.4
+    # the breaker's detection window: enough consecutive timeouts to
+    # reach the threshold, plus the open -> half-open wait
+    detection_s = breaker_threshold * request_timeout + breaker_reset_s
+
+    def p99(lats):
+        return (sorted(lats)[max(0, int(len(lats) * 0.99) - 1)]
+                if lats else None)
+
+    fleet = Fleet(spawner=spawner, heartbeat_interval=0.2,
+                  heartbeat_timeout=3.0,
+                  request_timeout=request_timeout,
+                  retry_budget=2,
+                  breaker_threshold=breaker_threshold,
+                  breaker_reset_s=breaker_reset_s)
+    router = None
+    try:
+        fleet.spawn(2)
+        fleet.wait_ready(2, timeout=240)
+        router = serve_fleet(fleet)
+
+        lats, errors = [], []
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def worker():
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    req = urllib.request.Request(
+                        router.url + "/predict", data=body,
+                        headers={"Content-Type": "application/json",
+                                 "X-Deadline-Ms": str(deadline_ms)})
+                    with urllib.request.urlopen(
+                            req, timeout=deadline_ms / 1e3) as r:
+                        r.read()
+                    with lock:
+                        lats.append(time.perf_counter() - t0)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(repr(e))
+
+        n_threads = 4
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        warm_s = 0.5 if fast else 1.5
+        time.sleep(warm_s)              # calm traffic through both
+        with lock:
+            calm_lats, calm_n = list(lats), len(lats)
+        calm_p99 = p99(calm_lats)
+
+        victim = next(iter(fleet._replicas.values()))
+        chaos_mod.sigstop(victim.proc)  # hung-but-TCP-alive
+        stopped_at = time.monotonic()
+        evicted_in = None
+        while time.monotonic() - stopped_at < 30.0:
+            if victim.state == EVICTED:
+                evicted_in = time.monotonic() - stopped_at
+                break
+            time.sleep(0.02)
+        time.sleep(0.5 if fast else 1.0)  # hammer the survivor
+        chaos_mod.sigcont(victim.proc)    # recovery half of the drill
+        cont_at = time.monotonic()
+        readmitted_in = None
+        while time.monotonic() - cont_at < 30.0:
+            if victim.state == READY:
+                readmitted_in = time.monotonic() - cont_at
+                break
+            time.sleep(0.05)
+        time.sleep(0.3)                   # traffic over the full fleet
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+
+        with lock:
+            drill_lats = lats[calm_n:]
+            n_errors = len(errors)
+            err_sample = errors[:3]
+        dp99 = p99(drill_lats)
+        bound = max(20 * calm_p99, 5.0) if calm_p99 else 5.0
+        snap = fleet.snapshot()
+        return {
+            "value": round(evicted_in, 3) if evicted_in else None,
+            "unit": "s_to_breaker_eviction",
+            "lower_is_better": True,
+            "requests": len(drill_lats) + calm_n,
+            "errors": n_errors,
+            "error_sample": err_sample,
+            "deadline_ms": deadline_ms,
+            "calm_p99_ms": (round(calm_p99 * 1e3, 2)
+                            if calm_p99 else None),
+            "drill_p99_ms": round(dp99 * 1e3, 2) if dp99 else None,
+            "p99_bound_ms": round(bound * 1e3, 2),
+            "eviction_reason": victim.eviction_reason,
+            "breaker_detection_window_s": detection_s,
+            "evicted_in_s": (round(evicted_in, 3)
+                             if evicted_in else None),
+            "readmitted_in_s": (round(readmitted_in, 3)
+                                if readmitted_in else None),
+            "request_timeouts": snap["request_timeouts"],
+            "breaker_opens": snap["breaker_opens"],
+            "retries": snap["retries"],
+            "gate_zero_errors_within_deadline": n_errors == 0,
+            "gate_breaker_eviction_bounded": bool(
+                evicted_in is not None
+                and evicted_in <= 2.0 * detection_s),
+            "gate_p99_bounded": bool(dp99 and dp99 <= bound),
+            "gate_half_open_readmission": readmitted_in is not None,
+        }
+    finally:
+        if router is not None:
+            router.close(stop_replicas=True)
+        else:
+            fleet.close(stop_replicas=True)
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_checkpoint():
     """Checkpoint subsystem config (docs/CHECKPOINTS.md): (a) the
     per-autosave STEP-LOOP STALL — blocking single-file npz writer
@@ -1067,6 +1232,7 @@ CONFIGS = {
     "guardian": bench_guardian,
     "serve": bench_serve,
     "fleet": bench_fleet,
+    "chaos": bench_chaos,
     "checkpoint": bench_checkpoint,
     "telemetry": bench_telemetry,
     "lenet": bench_lenet,
@@ -1083,6 +1249,7 @@ METRIC_NAMES = {
     "guardian": "guardian_guarded_step_time_ms",
     "serve": "serving_decode_tokens_per_sec_cached",
     "fleet": "fleet_predict_rows_per_sec_4_replicas",
+    "chaos": "chaos_sigstop_breaker_eviction_s",
     "checkpoint": "checkpoint_async_save_stall_ms",
     "telemetry": "telemetry_instrumented_step_time_ms",
     "lenet": "lenet_mnist_step_time_ms",

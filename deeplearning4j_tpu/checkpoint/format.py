@@ -44,6 +44,8 @@ from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
 
 import numpy as np
 
+from deeplearning4j_tpu.testing import chaos
+
 __all__ = [
     "FORMAT_NAME", "FORMAT_VERSION", "MANIFEST", "MARKER", "HostShard",
     "HostLeaf", "CheckpointError", "CorruptShardError", "step_dir_name",
@@ -260,7 +262,11 @@ def write_checkpoint(root: str, step: int, payload: Any, *,
 
     `between_files` is a test hook called with each filename just before
     it is written — crash-mid-save drills raise from it and assert the
-    step never becomes visible to readers.
+    step never becomes visible to readers. The chaos layer generalizes
+    it: the `checkpoint.write` / `checkpoint.rename` injection points
+    (deeplearning4j_tpu.testing.chaos) fire at the same sites, so
+    seeded IO-fault schedules drive the same crash-atomicity contract
+    without hand-wiring a callback.
     """
     leaves: Dict[str, HostLeaf] = {}
     tree = _encode_tree(payload, "", leaves)
@@ -286,6 +292,7 @@ def write_checkpoint(root: str, step: int, payload: Any, *,
             fname = f"{fname_base}.s{i:02d}.npy"
             if between_files is not None:
                 between_files(fname)
+            chaos.hit("checkpoint.write", file=fname)
             # NOT ascontiguousarray: it silently promotes 0-d scalars to
             # 1-d; tobytes() already yields C-order bytes for the crc
             data = np.asarray(shard.data)
@@ -318,6 +325,7 @@ def write_checkpoint(root: str, step: int, payload: Any, *,
     }
     if between_files is not None:
         between_files(MANIFEST)
+    chaos.hit("checkpoint.rename", file=MANIFEST)
     with open(os.path.join(step_dir, MANIFEST + ".tmp"), "w") as f:
         json.dump(manifest, f)
     os.replace(os.path.join(step_dir, MANIFEST + ".tmp"),
@@ -325,6 +333,7 @@ def write_checkpoint(root: str, step: int, payload: Any, *,
     # the commit point: marker appears atomically, LAST
     if between_files is not None:
         between_files(MARKER)
+    chaos.hit("checkpoint.rename", file=MARKER)
     with open(os.path.join(step_dir, MARKER + ".tmp"), "w") as f:
         json.dump({"step": int(step), "committed_at": time.time()}, f)
     os.replace(os.path.join(step_dir, MARKER + ".tmp"),

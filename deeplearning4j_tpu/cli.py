@@ -263,6 +263,10 @@ def cmd_fleet(args) -> int:
                   heartbeat_interval=args.heartbeat_interval,
                   heartbeat_timeout=args.heartbeat_timeout,
                   shed_high_water=args.shed_high_water,
+                  request_timeout=args.request_timeout,
+                  retry_budget=args.retry_budget,
+                  breaker_threshold=args.breaker_threshold,
+                  breaker_reset_s=args.breaker_reset,
                   autoscaler=autoscaler,
                   initial_checkpoint=(args.model
                                       if args.model
@@ -480,6 +484,23 @@ def build_parser() -> argparse.ArgumentParser:
     p_fleet.add_argument("--shed-high-water", type=int, default=None,
                          help="shed (503 + Retry-After) when this many "
                               "requests are in flight fleet-wide")
+    p_fleet.add_argument("--request-timeout", type=float, default=60.0,
+                         help="per-hop /predict socket timeout ceiling; "
+                              "requests carrying X-Deadline-Ms derive "
+                              "their hop timeouts from the remaining "
+                              "budget instead (docs/SERVING.md)")
+    p_fleet.add_argument("--retry-budget", type=int, default=2,
+                         help="max /predict retries on healthy peers "
+                              "after a replica failure or timeout")
+    p_fleet.add_argument("--breaker-threshold", type=int, default=3,
+                         help="consecutive request timeouts that trip a "
+                              "replica's circuit breaker open (evicting "
+                              "hung-but-TCP-alive members, docs/FLEET.md)")
+    p_fleet.add_argument("--breaker-reset", type=float, default=None,
+                         metavar="S",
+                         help="open -> half-open wait before the /readyz "
+                              "readmission probe (default: 4x the "
+                              "heartbeat interval)")
     p_fleet.add_argument("--autoscale", default=None, metavar="MIN:MAX",
                          help="enable the autoscaling hook between MIN "
                               "and MAX replicas (queue-depth driven)")

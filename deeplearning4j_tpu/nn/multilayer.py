@@ -38,6 +38,7 @@ from deeplearning4j_tpu.optimize.solver import Solver
 from deeplearning4j_tpu.optimize.updater import NetworkGradientUpdater
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.telemetry.trace import span
+from deeplearning4j_tpu.testing import chaos
 from deeplearning4j_tpu.utils.jitcache import jit_cache_size
 from deeplearning4j_tpu.utils.sanitize import validate_batch
 
@@ -568,6 +569,11 @@ class MultiLayerNetwork:
         return epoch
 
     def _backprop_fit(self, x, labels, n_valid=None, guard=None) -> None:
+        # chaos numeric-fault point (docs/FAULT_TOLERANCE.md): a "nan"
+        # rule poisons this batch on the host, producing the non-finite
+        # grads the guardian's on-device defense exists for; a no-op
+        # (one global check) without an active plan
+        x = chaos.maybe_nan("train.batch", x)
         conf0 = self.layers[-1].conf
         algo = conf0.optimization_algo.lower()
         guarded = guard is not None and guard.guarded

@@ -20,9 +20,14 @@ autoscaling hook. See docs/SERVING.md and docs/FLEET.md.
 """
 
 from deeplearning4j_tpu.serving.batcher import MicroBatcher  # noqa: F401
-from deeplearning4j_tpu.serving.errors import OverloadedError  # noqa: F401
+from deeplearning4j_tpu.serving.errors import (  # noqa: F401
+    Deadline,
+    DeadlineExceededError,
+    OverloadedError,
+)
 from deeplearning4j_tpu.serving.fleet import (  # noqa: F401
     Autoscaler,
+    CircuitBreaker,
     Fleet,
     FleetReplica,
     NoReadyReplicas,

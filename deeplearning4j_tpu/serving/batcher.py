@@ -33,6 +33,16 @@ Contract:
   requests already waiting raises `OverloadedError` (503 + Retry-After
   on the HTTP surface, docs/FLEET.md) instead of queueing unboundedly —
   shedding at the door beats timing out after the queue.
+- **Deadlines** (docs/SERVING.md "Deadlines"): `submit(x, deadline=)`
+  raises `DeadlineExceededError` for an already-expired budget, and the
+  worker re-checks at DISPATCH — a request whose budget died while it
+  queued fails without ever touching the engine (no compute is spent on
+  an answer nobody is waiting for). Pinned by the engine's
+  program-cache and the batcher's batch counters in tests.
+- **Cancellation**: a future the client abandoned (`fut.cancel()` after
+  a result timeout or disconnect) is dropped at dispatch — the standard
+  `set_running_or_notify_cancel()` handshake — and counted in
+  `dl4j_batcher_cancelled`.
 - `close()` stops accepting submits, flushes everything already queued,
   and joins the worker. Also usable as a context manager.
 """
@@ -49,7 +59,9 @@ from typing import Callable, NamedTuple, Optional
 import numpy as np
 
 from deeplearning4j_tpu import telemetry
-from deeplearning4j_tpu.serving.errors import OverloadedError
+from deeplearning4j_tpu.serving.errors import (Deadline,
+                                               DeadlineExceededError,
+                                               OverloadedError)
 
 __all__ = ["MicroBatcher"]
 
@@ -60,6 +72,7 @@ _batcher_seq = itertools.count()
 class _Request(NamedTuple):
     x: np.ndarray
     future: Future
+    deadline: Optional[Deadline] = None
 
 
 def _resolve(fut: Future, value=None, exc: Optional[BaseException] = None
@@ -117,6 +130,14 @@ class MicroBatcher:
             "dl4j_batcher_shed",
             "requests rejected at submit because the coalescing queue "
             "was at max_queue").labels(**lab)
+        self._m_deadline = reg.counter(
+            "dl4j_batcher_deadline_exceeded",
+            "requests shed (at submit or at dispatch) because their "
+            "deadline budget was already spent").labels(**lab)
+        self._m_cancelled = reg.counter(
+            "dl4j_batcher_cancelled",
+            "abandoned requests (client-cancelled futures) dropped at "
+            "dispatch").labels(**lab)
         self._m_queue = reg.gauge(
             "dl4j_batcher_queue_depth",
             "requests waiting in the coalescing queue").labels(**lab)
@@ -151,9 +172,15 @@ class MicroBatcher:
         return int(self._m_rows.value)
 
     # ----------------------------------------------------------- submit
-    def submit(self, x) -> Future:
+    def submit(self, x, deadline: Optional[Deadline] = None) -> Future:
         """Enqueue one request; the future resolves to the engine output
-        rows for exactly these input rows."""
+        rows for exactly these input rows. An already-expired `deadline`
+        raises DeadlineExceededError here (504 on the HTTP surface) —
+        and is re-checked at dispatch, so a budget that dies in the
+        queue never reaches the engine either."""
+        if deadline is not None and deadline.expired:
+            self._m_deadline.inc()
+            deadline.check("batcher admission")  # raises
         fut: Future = Future()
         arr = np.asarray(x)
         if arr.ndim == 0:
@@ -181,7 +208,7 @@ class MicroBatcher:
             # enqueue under the lock: close() also takes it before
             # putting the sentinel, so no request can land AFTER _CLOSE
             # and strand its future in a dead queue
-            self._q.put(_Request(arr, fut))
+            self._q.put(_Request(arr, fut, deadline))
         return fut
 
     # ----------------------------------------------------------- worker
@@ -208,6 +235,28 @@ class MicroBatcher:
         return batch, None
 
     def _run_group(self, batch) -> None:
+        # dispatch-time gate: drop abandoned futures (the client gave
+        # up — set_running_or_notify_cancel is the std handshake) and
+        # fail queue-expired deadlines WITHOUT engine work; both are
+        # decided before the batch's reference shape is picked so a
+        # dead request never anchors the live ones' validation
+        alive = []
+        for req in batch:
+            if not req.future.set_running_or_notify_cancel():
+                self._m_cancelled.inc()
+                continue
+            if req.deadline is not None and req.deadline.expired:
+                self._m_deadline.inc()
+                self._m_failed.inc()
+                _resolve(req.future, exc=DeadlineExceededError(
+                    "deadline exceeded while queued in the batcher",
+                    deadline_ms=req.deadline.budget_ms,
+                    elapsed_ms=req.deadline.elapsed_ms()))
+                continue
+            alive.append(req)
+        if not alive:
+            return
+        batch = alive
         # per-request validation against the batch's first request: a
         # mismatched request fails alone, the rest still run
         tail = batch[0].x.shape[1:]
@@ -284,6 +333,8 @@ class MicroBatcher:
             "mean_rows_per_batch": round(per_batch, 2),
             "occupancy": round(per_batch / self.max_batch_size, 4),
             "shed": int(self._m_shed.value),
+            "deadline_exceeded": int(self._m_deadline.value),
+            "cancelled": int(self._m_cancelled.value),
             "queue_depth": self._q.qsize(),
             "max_batch_size": self.max_batch_size,
             "max_queue": self.max_queue,

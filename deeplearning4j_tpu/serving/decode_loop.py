@@ -70,7 +70,9 @@ import numpy as np
 
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.models.transformer import TransformerConfig
-from deeplearning4j_tpu.serving.errors import OverloadedError
+from deeplearning4j_tpu.serving.errors import (Deadline,
+                                               DeadlineExceededError,
+                                               OverloadedError)
 from deeplearning4j_tpu.serving.paged_kv import (init_paged_pool,
                                                  paged_decode_step,
                                                  paged_kv_bytes,
@@ -95,19 +97,23 @@ class GenerationStream:
     (the HTTP streaming response iterates it); `result()` blocks until
     the stream finishes and returns the full generated list;
     `full_sequence()` is prompt + generated — the backward-compatible
-    `/generate` response row. `finish_reason` is "eos", "max_tokens" or
-    "error" once done."""
+    `/generate` response row. `finish_reason` is "eos", "max_tokens",
+    "cancelled", "deadline_exceeded" or "error" once done."""
 
     def __init__(self, prompt: Sequence[int], max_tokens: int,
-                 eos_id: Optional[int]):
+                 eos_id: Optional[int],
+                 deadline: Optional[Deadline] = None):
         self.prompt: List[int] = [int(t) for t in prompt]
         self.max_tokens = int(max_tokens)
         self.eos_id = None if eos_id is None else int(eos_id)
+        self.deadline = deadline
         self.finish_reason: Optional[str] = None
         self.error: Optional[BaseException] = None
         self._generated: List[int] = []
         self._q: "queue.Queue" = queue.Queue()
         self._done = threading.Event()
+        self._cancelled = threading.Event()
+        self._loop_ref = None  # weakref to the owning loop, set at submit
 
     # ------------------------------------------------- scheduler side
     def _emit(self, token: int) -> None:
@@ -145,6 +151,27 @@ class GenerationStream:
     @property
     def done(self) -> bool:
         return self._done.is_set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def cancel(self) -> bool:
+        """Retire this request: its decode slot is released and its KV
+        pages return to the pool at the scheduler's NEXT pass (one
+        dispatch boundary — the disconnect-handling contract,
+        docs/SERVING.md "Cancellation"). Idempotent; returns True when
+        the cancel was accepted (the stream had not already finished).
+        The stream then finishes with `finish_reason == "cancelled"`
+        and `result()` returns the tokens generated so far."""
+        if self._done.is_set():
+            return False
+        self._cancelled.set()
+        loop = self._loop_ref() if self._loop_ref is not None else None
+        if loop is not None:
+            with loop._cond:
+                loop._cond.notify_all()  # wake an idle scheduler now
+        return True
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Block until finished; return the generated token ids (EOS
@@ -294,6 +321,16 @@ class DecodeLoop:
             "dl4j_decode_shed",
             "generate requests rejected at submit because the admission "
             "queue was at max_waiting").labels(**lab)
+        self._m_deadline = reg.counter(
+            "dl4j_decode_deadline_exceeded",
+            "generate requests shed at submit/admission, or reaped "
+            "mid-flight, because their deadline budget was spent"
+        ).labels(**lab)
+        self._m_cancelled = reg.counter(
+            "dl4j_decode_cancelled",
+            "generate requests cancelled (client disconnect or "
+            "GenerationStream.cancel) — slot retired, pages freed"
+        ).labels(**lab)
         reg.gauge(
             "dl4j_kv_pages_total",
             "usable KV pages in the block pool").labels(**lab).set(
@@ -339,23 +376,35 @@ class DecodeLoop:
         return prompt
 
     def submit(self, prompt, max_tokens: int,
-               eos_id: Optional[int] = None) -> GenerationStream:
+               eos_id: Optional[int] = None,
+               deadline: Optional[Deadline] = None) -> GenerationStream:
         """Queue one prompt (1-D int sequence). The stream's first token
         arrives after admission + prefill; termination on EOS (when
         given), `max_tokens`, or the model window."""
-        return self.submit_many([prompt], max_tokens, eos_id)[0]
+        return self.submit_many([prompt], max_tokens, eos_id,
+                                deadline=deadline)[0]
 
     def submit_many(self, prompts, max_tokens: int,
-                    eos_id: Optional[int] = None
+                    eos_id: Optional[int] = None,
+                    deadline: Optional[Deadline] = None
                     ) -> List[GenerationStream]:
         """Admit several rows as ONE unit: all rows enqueue or none do.
         A shed that fired between a multi-row request's submits would
         orphan the already-queued row-mates in running slots (no
         consumer ever reads them), so the /generate handler routes
-        every multi-row body through here."""
+        every multi-row body through here. An already-expired `deadline`
+        sheds the whole group here; one that expires while queued sheds
+        at admission — either way before any prefill compute."""
+        if deadline is not None and deadline.expired:
+            self._m_deadline.inc()
+            deadline.check("decode admission")  # raises
         prompts = [self.validate(p, max_tokens) for p in prompts]
-        streams = [GenerationStream(p, max_tokens, eos_id)
+        streams = [GenerationStream(p, max_tokens, eos_id,
+                                    deadline=deadline)
                    for p in prompts]
+        loop_ref = weakref.ref(self)
+        for stream in streams:
+            stream._loop_ref = loop_ref
         with self._cond:
             if self._closed:
                 raise RuntimeError("decode loop is closed")
@@ -444,6 +493,8 @@ class DecodeLoop:
                 "requests": int(self._m_requests.value),
                 "tokens_streamed": int(self._m_tokens.value),
                 "shed": int(self._m_shed.value),
+                "deadline_exceeded": int(self._m_deadline.value),
+                "cancelled": int(self._m_cancelled.value),
                 "admission_waits": int(self._m_waits.value),
                 "dispatches": int(self._m_steps.value),
                 "decode_step_programs": self.decode_step_programs(),
@@ -502,6 +553,7 @@ class DecodeLoop:
         retire finished slots. Returns True if a dispatch ran. Public so
         tests (and `start=False` callers) can drive the loop
         deterministically."""
+        self._reap()
         self._admit()
         ran = self._dispatch()
         if not ran:
@@ -535,6 +587,31 @@ class DecodeLoop:
             self.tick()
         raise RuntimeError("decode loop did not drain")
 
+    # ---- cancellation / expiry reaping
+    def _reap(self) -> None:
+        """Retire occupied slots whose stream was cancelled (client
+        disconnect, explicit `cancel()`) or whose deadline budget died
+        mid-flight: the slot is released and its pages return to the
+        pool within THIS scheduler pass — an abandoned stream must not
+        keep burning pages (docs/SERVING.md "Cancellation")."""
+        with self._cond:
+            for i, slot in enumerate(self._slot_state):
+                if slot is None:
+                    continue
+                stream = slot.stream
+                if stream.cancelled:
+                    self._m_cancelled.inc()
+                    self._retire(i, slot, "cancelled")
+                elif (stream.deadline is not None
+                      and stream.deadline.expired):
+                    self._m_deadline.inc()
+                    self._retire(i, slot, "deadline_exceeded",
+                                 error=DeadlineExceededError(
+                                     "deadline exceeded mid-generation",
+                                     deadline_ms=stream.deadline.budget_ms,
+                                     elapsed_ms=stream.deadline
+                                     .elapsed_ms()))
+
     # ---- admission
     def _admit(self) -> None:
         import jax.numpy as jnp
@@ -546,6 +623,24 @@ class DecodeLoop:
                     if s is not None}
             while self._waiting:
                 stream = self._waiting[0]
+                # queue-expired or cancelled work is shed here, BEFORE
+                # any prefill compute (the dispatch counters pin it)
+                if stream.cancelled:
+                    self._waiting.popleft()
+                    self._m_cancelled.inc()
+                    stream._finish("cancelled")
+                    continue
+                if (stream.deadline is not None
+                        and stream.deadline.expired):
+                    self._waiting.popleft()
+                    self._m_deadline.inc()
+                    stream._finish(
+                        "deadline_exceeded", DeadlineExceededError(
+                            "deadline exceeded while queued for a "
+                            "decode slot",
+                            deadline_ms=stream.deadline.budget_ms,
+                            elapsed_ms=stream.deadline.elapsed_ms()))
+                    continue
                 plen = len(stream.prompt)
                 # prompt pages + room for the first decode write: the
                 # admission check that replaces the contiguous path's
@@ -711,7 +806,8 @@ class DecodeLoop:
         elif slot.emitted >= stream.max_tokens:
             self._retire(idx, slot, "max_tokens")
 
-    def _retire(self, idx: int, slot: _Slot, reason: str) -> None:
+    def _retire(self, idx: int, slot: _Slot, reason: str,
+                error: Optional[BaseException] = None) -> None:
         with self._cond:
             self._slot_state[idx] = None
             self._table[idx, :] = self._trash
@@ -721,4 +817,4 @@ class DecodeLoop:
             self._free.extend(slot.pages)
             self._dirty = True
             self._cond.notify_all()  # admissions may proceed
-        slot.stream._finish(reason)
+        slot.stream._finish(reason, error)

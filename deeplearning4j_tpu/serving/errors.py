@@ -9,13 +9,32 @@ RuntimeError, and every HTTP front end maps it to the same wire shape —
 `503` with a `Retry-After` header and a JSON body
 `{"error": "overloaded", "retry_after_ms": N}` — so clients and load
 balancers can back off without parsing prose (docs/FLEET.md).
+
+`Deadline` / `DeadlineExceededError` are the end-to-end time-budget
+twins: a client sends `deadline_ms` (an `X-Deadline-Ms` header, or a
+`deadline_ms` body field where the body is parsed anyway), every hop
+re-derives its socket timeout from the REMAINING budget instead of a
+fixed constant, the router forwards the shrunk budget downstream, and
+every admission point (router select, micro-batcher submit AND
+dispatch, decode-loop submit AND admission) sheds already-expired work
+with the machine-readable shape `504` +
+`{"error": "deadline_exceeded", "deadline_ms": D, "elapsed_ms": E}`
+BEFORE any compute starts (docs/SERVING.md "Deadlines").
 """
 
 from __future__ import annotations
 
 import math
+import time
+from typing import Optional
 
-__all__ = ["OverloadedError", "overload_body"]
+__all__ = ["OverloadedError", "overload_body",
+           "Deadline", "DeadlineExceededError", "deadline_body",
+           "DEADLINE_HEADER"]
+
+#: the wire header carrying the REMAINING budget in milliseconds; each
+#: forwarding hop rewrites it smaller (never larger)
+DEADLINE_HEADER = "X-Deadline-Ms"
 
 
 class OverloadedError(RuntimeError):
@@ -37,3 +56,106 @@ def overload_body(exc: OverloadedError) -> dict:
     return {"error": "overloaded",
             "retry_after_ms": exc.retry_after_ms,
             "detail": str(exc)}
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's end-to-end time budget ran out. Raised by every
+    admission point BEFORE compute starts (shedding expired work is
+    free; finishing it is worthless), and by result waits that hit the
+    budget. HTTP front ends map it to 504 + `deadline_body`."""
+
+    def __init__(self, message: str,
+                 deadline_ms: Optional[int] = None,
+                 elapsed_ms: Optional[int] = None):
+        super().__init__(message)
+        self.deadline_ms = deadline_ms
+        self.elapsed_ms = elapsed_ms
+
+
+def deadline_body(exc: DeadlineExceededError) -> dict:
+    """The JSON body every 504-deadline-exceeded reply carries."""
+    out = {"error": "deadline_exceeded", "detail": str(exc)}
+    if exc.deadline_ms is not None:
+        out["deadline_ms"] = exc.deadline_ms
+    if exc.elapsed_ms is not None:
+        out["elapsed_ms"] = exc.elapsed_ms
+    return out
+
+
+class Deadline:
+    """A monotonic end-to-end budget: created once where the request
+    enters the process, consulted at every hop.
+
+    `None` deadlines are represented by the absence of a Deadline (the
+    constructors return None), so hot paths stay `if deadline is None`
+    checks and legacy fixed timeouts apply unchanged."""
+
+    __slots__ = ("budget_ms", "_expires")
+
+    def __init__(self, budget_ms: float):
+        self.budget_ms = int(budget_ms)
+        self._expires = time.monotonic() + self.budget_ms / 1000.0
+
+    # ------------------------------------------------------ constructors
+    @classmethod
+    def from_ms(cls, ms) -> Optional["Deadline"]:
+        """Budget in milliseconds from NOW; None/absent -> no deadline.
+        0 is legal and already expired (the canonical "shed me at every
+        admission point" probe)."""
+        if ms is None:
+            return None
+        ms = float(ms)
+        if ms < 0:
+            raise ValueError(f"deadline_ms must be >= 0, got {ms}")
+        return cls(ms)
+
+    @classmethod
+    def from_request(cls, headers=None, body=None) -> Optional["Deadline"]:
+        """Parse a request's budget: the `X-Deadline-Ms` header wins
+        (the router forwards budgets as headers so replicas never need
+        to parse the body), else a `deadline_ms` body field."""
+        raw = headers.get(DEADLINE_HEADER) if headers is not None else None
+        if raw is None and isinstance(body, dict):
+            raw = body.get("deadline_ms")
+        return cls.from_ms(raw) if raw is not None else None
+
+    # --------------------------------------------------------- the clock
+    def remaining_s(self) -> float:
+        return self._expires - time.monotonic()
+
+    def remaining_ms(self) -> float:
+        return self.remaining_s() * 1000.0
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining_s() <= 0.0
+
+    def elapsed_ms(self) -> int:
+        return int(self.budget_ms - self.remaining_ms())
+
+    def check(self, where: str) -> None:
+        """Raise DeadlineExceededError if the budget is spent — the
+        one-liner every admission point calls before doing work."""
+        if self.expired:
+            raise DeadlineExceededError(
+                f"deadline exceeded before {where} "
+                f"({self.budget_ms}ms budget spent)",
+                deadline_ms=self.budget_ms,
+                elapsed_ms=self.elapsed_ms())
+
+    def timeout(self, default: float, floor: float = 0.05) -> float:
+        """Per-hop socket/wait timeout derived from the remaining
+        budget: min(default, remaining), floored so an almost-spent
+        budget still makes a bounded attempt instead of a 0s timeout
+        (the admission-point `check()` is what sheds truly expired
+        work)."""
+        return max(floor, min(float(default), self.remaining_s()))
+
+    def header_value(self) -> str:
+        """Remaining budget for the forwarded `X-Deadline-Ms` header
+        (ceil, >= 1 — a still-unexpired budget never forwards as 0)."""
+        return str(max(1, math.ceil(self.remaining_ms())))
+
+    def __repr__(self) -> str:
+        return (f"Deadline(budget_ms={self.budget_ms}, "
+                f"remaining_ms={self.remaining_ms():.0f})")

@@ -37,9 +37,22 @@ Routing is least-outstanding-requests over READY replicas (round-robin
 tiebreak — the same policy `ReplicaSet` applies intra-process), with:
 
 - **retries**: idempotent `/predict` replays on a healthy peer after a
-  connection failure or replica 5xx; a connection-level failure also
-  evicts the replica immediately (faster than the heartbeat timeout —
-  the monitor readmits it when it answers `/readyz` again).
+  connection failure, request timeout, or replica 5xx — under an
+  explicit `retry_budget`, with each hop's socket timeout derived from
+  the request's remaining `deadline_ms` budget (docs/SERVING.md
+  "Deadlines") so a hung replica costs a slice of the budget, not the
+  fixed 30s client timeout; a connection-level failure also evicts the
+  replica immediately (faster than the heartbeat timeout — the monitor
+  readmits it when it answers `/readyz` again).
+- **hung-replica defense**: a request TIMEOUT marks the replica
+  SUSPECT (deprioritized, still probed) and feeds its per-replica
+  circuit breaker — closed → open after `breaker_threshold`
+  consecutive timeouts (the replica is EVICTED: hung-but-TCP-alive
+  members, e.g. SIGSTOP'd or with a wedged handler pool, answer
+  health probes the heartbeat path trusts) → half-open after
+  `breaker_reset_s` (one `/readyz` probe) → closed on success
+  (readmission). One pathological request still cannot evict a
+  replica; N consecutive ones can (docs/FLEET.md "Chaos runbook").
 - **load shedding**: total in-flight past `shed_high_water` answers
   503 + `Retry-After` + `{"error": "overloaded", ...}` before any
   replica is touched.
@@ -64,10 +77,12 @@ readmission/reload counters, per-route latency histograms,
 
 from __future__ import annotations
 
+import atexit
 import itertools
 import json
 import logging
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -78,26 +93,116 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.scaleout.statetracker import InMemoryStateTracker
-from deeplearning4j_tpu.serving.errors import OverloadedError
+from deeplearning4j_tpu.serving.errors import (DEADLINE_HEADER, Deadline,
+                                               OverloadedError)
 from deeplearning4j_tpu.serving.router import ReplicaClient
 
 __all__ = ["Fleet", "FleetReplica", "ReplicaSpawner", "Autoscaler",
-           "NoReadyReplicas",
-           "STARTING", "READY", "DRAINING", "EVICTED"]
+           "CircuitBreaker", "NoReadyReplicas",
+           "STARTING", "READY", "SUSPECT", "DRAINING", "EVICTED"]
 
 log = logging.getLogger(__name__)
 
 STARTING = "starting"
 READY = "ready"
+#: READY member with recent request timeouts: still alive by every
+#: probe, deprioritized for routing, one breaker trip from EVICTED
+SUSPECT = "suspect"
 DRAINING = "draining"
 EVICTED = "evicted"
-STATES = (STARTING, READY, DRAINING, EVICTED)
+STATES = (STARTING, READY, SUSPECT, DRAINING, EVICTED)
 
 _fleet_seq = itertools.count()
 
 
 class NoReadyReplicas(RuntimeError):
     """No replica is in the READY state (the router answers 503)."""
+
+
+class CircuitBreaker:
+    """Per-replica request-timeout breaker (mutations happen under the
+    owning fleet's lock).
+
+    closed --(threshold consecutive timeouts)--> open
+    open   --(reset_s elapsed, one /readyz probe)--> half_open
+    half_open --(probe ok)--> closed | --(probe fails)--> open
+
+    The heartbeat monitor sees liveness; THIS sees request progress —
+    a SIGSTOP'd replica (the kernel keeps accepting into the listen
+    backlog) or a wedged handler pool passes every health probe and
+    only the breaker evicts it. Any success fully closes the breaker;
+    one success is what a half-open trial is for."""
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, threshold: int = 3, reset_s: float = 2.0):
+        if threshold < 1:
+            raise ValueError(f"threshold must be >= 1, got {threshold}")
+        self.threshold = int(threshold)
+        self.reset_s = float(reset_s)
+        #: a "consecutive" streak whose previous timeout is older than
+        #: this is no streak at all — without the horizon, 2-of-3
+        #: timeouts from a transient blip would arm the breaker
+        #: forever, and ONE slow request hours later would evict a
+        #: healthy replica (a suspect's probing trickle fires well
+        #: inside this window, so real hangs still accumulate)
+        self.streak_ttl_s = max(30.0, 10.0 * self.reset_s)
+        self.state = self.CLOSED
+        self.consecutive_timeouts = 0
+        self.opened_at: Optional[float] = None
+        self.last_timeout_at: Optional[float] = None
+        self.opens = 0  # lifetime closed/half_open -> open transitions
+
+    def record_timeout(self) -> bool:
+        """Count one request timeout; returns True when this one OPENS
+        the breaker (the caller evicts)."""
+        now = time.monotonic()
+        if (self.state == self.CLOSED
+                and self.last_timeout_at is not None
+                and now - self.last_timeout_at > self.streak_ttl_s):
+            self.consecutive_timeouts = 0  # ancient streak: start over
+        self.consecutive_timeouts += 1
+        self.last_timeout_at = now
+        trip = (self.state == self.HALF_OPEN
+                or self.consecutive_timeouts >= self.threshold)
+        if trip and self.state != self.OPEN:
+            self.state = self.OPEN
+            self.opened_at = time.monotonic()
+            self.opens += 1
+            return True
+        if trip:
+            self.opened_at = time.monotonic()  # re-arm the reset clock
+        return False
+
+    def record_success(self) -> None:
+        self.consecutive_timeouts = 0
+        self.state = self.CLOSED
+        self.opened_at = None
+
+    def allow_probe(self) -> bool:
+        """True when a half-open `/readyz` probe may run: open breakers
+        wait out `reset_s` first (and transition to half_open here)."""
+        if self.state == self.OPEN:
+            if (self.opened_at is not None
+                    and time.monotonic() - self.opened_at >= self.reset_s):
+                self.state = self.HALF_OPEN
+                return True
+            return False
+        return True  # closed / half_open: probing is always fine
+
+    def reopen(self) -> None:
+        """A half-open probe failed: back to open, clock re-armed."""
+        self.state = self.OPEN
+        self.opened_at = time.monotonic()
+
+    def snapshot(self) -> dict:
+        return {"state": self.state,
+                "consecutive_timeouts": self.consecutive_timeouts,
+                "opens": self.opens,
+                "threshold": self.threshold,
+                "reset_s": self.reset_s}
 
 
 class FleetReplica:
@@ -107,7 +212,8 @@ class FleetReplica:
 
     def __init__(self, replica_id: str, client: ReplicaClient,
                  proc: Optional[subprocess.Popen] = None,
-                 spawned: bool = False):
+                 spawned: bool = False,
+                 breaker: Optional[CircuitBreaker] = None):
         self.id = replica_id
         self.client = client
         self.proc = proc
@@ -115,6 +221,7 @@ class FleetReplica:
         self.state = STARTING
         self.outstanding = 0
         self.failures = 0          # consecutive request-path failures
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
         self.last_ready: Optional[dict] = None
         self.admitted_at: Optional[float] = None
         self.evicted_at: Optional[float] = None
@@ -124,7 +231,8 @@ class FleetReplica:
         now = now if now is not None else time.time()
         out = {"url": self.client.url, "state": self.state,
                "outstanding": self.outstanding,
-               "failures": self.failures, "spawned": self.spawned}
+               "failures": self.failures, "spawned": self.spawned,
+               "breaker": self.breaker.snapshot()}
         if self.proc is not None:
             out["pid"] = self.proc.pid
             out["proc_alive"] = self.proc.poll() is None
@@ -136,6 +244,49 @@ class FleetReplica:
         return out
 
 
+# spawned replica processes still alive, reaped at interpreter exit: a
+# router that dies without close() must not leak live replica servers
+# holding ports. Each replica runs in its OWN session/process group
+# (start_new_session), so the atexit sweep killpg's replicas (and any
+# grandchildren) without ever touching the router's group.
+_SPAWNED_PROCS: set = set()
+_spawn_lock = threading.Lock()
+_atexit_armed = False
+
+
+def _register_spawned(proc: subprocess.Popen) -> None:
+    global _atexit_armed
+    with _spawn_lock:
+        _SPAWNED_PROCS.add(proc)
+        if not _atexit_armed:
+            atexit.register(_kill_spawned_orphans)
+            _atexit_armed = True
+
+
+def _unregister_spawned(proc: subprocess.Popen) -> None:
+    with _spawn_lock:
+        _SPAWNED_PROCS.discard(proc)
+
+
+def _kill_spawned_orphans() -> None:
+    with _spawn_lock:
+        procs = list(_SPAWNED_PROCS)
+        _SPAWNED_PROCS.clear()
+    for proc in procs:
+        # each spawn is its own session leader, so pgid == proc.pid —
+        # never os.getpgid(), which fails once the leader is reaped
+        # even while grandchildren keep the group (and their ports)
+        # alive. killpg works as long as ANY group member lives.
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            if proc.poll() is None:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+
+
 class ReplicaSpawner:
     """Spawns local replica server processes (`cli serve` with async
     warmup) and reads each one's announce line for its URL.
@@ -143,7 +294,9 @@ class ReplicaSpawner:
     This is the single-host spawner (the autoscaling hook's local
     backend and the test/bench harness); a multi-host deployment
     attaches remote replicas by URL instead and brings its own process
-    manager."""
+    manager. Every spawn lands in its own process group and a
+    module-level atexit sweep SIGKILLs whatever `stop()` never reaped —
+    a router crash-exit cannot orphan replica servers on live ports."""
 
     def __init__(self, model_path: str, *, host: str = "127.0.0.1",
                  serve_args: Sequence[str] = (),
@@ -167,11 +320,18 @@ class ReplicaSpawner:
               ) -> Tuple[subprocess.Popen, str]:
         """Launch one replica process; returns (proc, url). The
         replica announces fast (async warmup) — readiness is gated by
-        its /readyz, not by this call."""
+        its /readyz, not by this call. The process gets its own
+        session/group and is registered for atexit orphan cleanup."""
         proc = subprocess.Popen(
             self.command(port), env=self.env, text=True,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-        url = self._read_announce(proc)
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        _register_spawned(proc)
+        try:
+            url = self._read_announce(proc)
+        except BaseException:
+            _unregister_spawned(proc)
+            raise
         return proc, url
 
     def _read_announce(self, proc: subprocess.Popen) -> str:
@@ -207,13 +367,30 @@ class ReplicaSpawner:
 
     @staticmethod
     def stop(proc: subprocess.Popen, timeout: float = 10.0) -> None:
+        """Terminate a spawned replica and its whole process group.
+
+        Ordering matters: the group SIGKILL sweep runs BEFORE the
+        leader is reaped — the un-reaped leader (alive or zombie) pins
+        pid == pgid, so the sweep can never hit a recycled pid. After
+        a reap, an emptied group's id is free for reuse and a blind
+        killpg could SIGKILL an unrelated process group."""
         if proc.poll() is None:
-            proc.terminate()
+            # TERM the whole group (leader un-reaped: raceless), give
+            # it the graceful window, then KILL stragglers — still
+            # before any reap
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except (OSError, ProcessLookupError):
+                proc.terminate()
             try:
                 proc.wait(timeout=timeout)
             except subprocess.TimeoutExpired:
-                proc.kill()
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    proc.kill()
                 proc.wait(timeout=timeout)
+        _unregister_spawned(proc)
 
 
 class Autoscaler:
@@ -265,6 +442,9 @@ class Fleet:
                  probe_timeout: float = 2.0,
                  request_timeout: float = 60.0,
                  generate_timeout: float = 300.0,
+                 retry_budget: int = 2,
+                 breaker_threshold: int = 3,
+                 breaker_reset_s: Optional[float] = None,
                  autoscaler: Optional[Autoscaler] = None,
                  initial_checkpoint: Optional[str] = None,
                  name: Optional[str] = None,
@@ -273,9 +453,23 @@ class Fleet:
         self.autoscaler = autoscaler
         self.heartbeat_interval = float(heartbeat_interval)
         self.shed_high_water = shed_high_water
+        #: monitor probes use this short dedicated timeout, never the
+        #: ReplicaClient default — and the sweep probes replicas
+        #: CONCURRENTLY, so one hung replica costs the sweep one probe
+        #: timeout instead of stalling every later probe past the
+        #: heartbeat window
         self.probe_timeout = float(probe_timeout)
         self.request_timeout = float(request_timeout)
         self.generate_timeout = float(generate_timeout)
+        #: retries (attempts after the first) forward_predict may spend
+        #: on peers after a failure; deadline budgets are split across
+        #: the attempts this allows
+        self.retry_budget = max(0, int(retry_budget))
+        self.breaker_threshold = int(breaker_threshold)
+        #: open -> half_open wait; default: a few monitor passes
+        self.breaker_reset_s = (float(breaker_reset_s)
+                                if breaker_reset_s is not None
+                                else 4.0 * self.heartbeat_interval)
         #: checkpoint the fleet currently serves — the implicit
         #: rollback target of a failed canary (rolling_reload updates
         #: it; None until a reload or an explicit initial_checkpoint)
@@ -320,6 +514,21 @@ class Fleet:
             "dl4j_fleet_retries",
             "predict retries on a healthy peer after a replica "
             "failure").labels(**lab)
+        self._m_deadline = {
+            route: reg.counter(
+                "dl4j_fleet_deadline_exceeded",
+                "requests shed at the router because their deadline "
+                "budget was already spent").labels(route=route, **lab)
+            for route in ("predict", "generate")}
+        self._m_timeouts = reg.counter(
+            "dl4j_fleet_request_timeouts",
+            "request-path timeouts (the circuit breaker's input — a "
+            "hung-but-TCP-alive replica shows up here first)").labels(
+                **lab)
+        self._m_breaker_opens = reg.counter(
+            "dl4j_fleet_breaker_opens",
+            "circuit breakers tripped open (the replica is evicted "
+            "until a half-open /readyz probe passes)").labels(**lab)
         self._m_evictions = reg.counter(
             "dl4j_fleet_evictions",
             "replicas evicted (stale heartbeat, lost readiness, or "
@@ -347,6 +556,15 @@ class Fleet:
                 (lambda st: lambda: (
                     (lambda o: o.state_counts().get(st, 0) if o else 0)(
                         ref())))(state))
+        for bstate in (CircuitBreaker.CLOSED, CircuitBreaker.HALF_OPEN,
+                       CircuitBreaker.OPEN):
+            reg.gauge(
+                "dl4j_fleet_breaker",
+                "replica circuit breakers by state").labels(
+                    state=bstate, **lab).set_function(
+                (lambda st: lambda: (
+                    (lambda o: o.breaker_counts().get(st, 0) if o else 0)(
+                        ref())))(bstate))
         reg.gauge(
             "dl4j_fleet_outstanding",
             "in-flight requests across the fleet").labels(
@@ -396,7 +614,10 @@ class Fleet:
             if rid in self._replicas:
                 raise ValueError(f"replica id {rid!r} already attached")
             rep = FleetReplica(rid, ReplicaClient(url), proc=proc,
-                               spawned=spawned)
+                               spawned=spawned,
+                               breaker=CircuitBreaker(
+                                   threshold=self.breaker_threshold,
+                                   reset_s=self.breaker_reset_s))
             self._replicas[rid] = rep
         self.tracker.add_worker(rid)
         return rep
@@ -459,11 +680,27 @@ class Fleet:
     def poll(self) -> None:
         """One monitor pass: probe every replica, evict the stale,
         readmit rejoiners, run the autoscaler. Public so tests drive
-        it deterministically."""
+        it deterministically. Probes run CONCURRENTLY with the short
+        dedicated `probe_timeout`: one hung replica (SIGSTOP'd, wedged
+        accept loop) costs the sweep a single probe window — it can
+        never starve the other replicas' heartbeats past the staleness
+        eviction threshold."""
         with self._lock:
             reps = list(self._replicas.values())
-        for rep in reps:
-            self._probe(rep)
+        if len(reps) == 1:
+            self._probe(reps[0])
+        elif reps:
+            threads = [threading.Thread(target=self._probe, args=(rep,),
+                                        daemon=True,
+                                        name=f"fleet-probe-{rep.id}")
+                       for rep in reps]
+            for t in threads:
+                t.start()
+            # both probes (healthz + readyz) are socket-timeout bound,
+            # so the join wall is ~2 probe windows whatever hangs
+            join_by = time.monotonic() + 2.0 * self.probe_timeout + 1.0
+            for t in threads:
+                t.join(timeout=max(0.0, join_by - time.monotonic()))
         # the scaleout eviction idiom: stale heartbeats name the dead
         for wid in self.tracker.stale_workers():
             with self._lock:
@@ -483,16 +720,35 @@ class Fleet:
         self.tracker.heartbeat(rep.id)
         if rep.state == DRAINING:
             return  # mid-reload/retire: rolling_reload owns its state
+        with self._lock:
+            # breaker-evicted members readmit ONLY through the breaker's
+            # half-open window: /readyz may well answer 200 on a replica
+            # whose request path is still wedged, so an open breaker
+            # outranks a healthy-looking readiness probe until reset_s
+            # has elapsed
+            half_open_trial = (rep.state == EVICTED
+                               and rep.breaker.state != CircuitBreaker.CLOSED)
+            if half_open_trial and not rep.breaker.allow_probe():
+                return
         try:
             ready, payload = rep.client.readyz(
                 timeout=self.probe_timeout)
         except Exception:
+            if half_open_trial:
+                with self._lock:
+                    rep.breaker.reopen()
             return
         rep.last_ready = payload
         if ready and rep.state in (STARTING, EVICTED):
+            with self._lock:
+                rep.breaker.record_success()  # closes a half-open trial
             self._admit(rep)
-        elif not ready and rep.state == READY:
-            self._evict(rep, payload.get("reason", "readiness lost"))
+        elif not ready:
+            if half_open_trial:
+                with self._lock:
+                    rep.breaker.reopen()
+            if rep.state in (READY, SUSPECT):
+                self._evict(rep, payload.get("reason", "readiness lost"))
 
     def _admit(self, rep: FleetReplica) -> None:
         with self._lock:
@@ -520,19 +776,56 @@ class Fleet:
                     rep.id, reason)
 
     def note_request_failure(self, rep: FleetReplica,
-                             exc: BaseException) -> None:
+                             exc: BaseException,
+                             breaker_eligible: bool = True) -> None:
         """Request-path failure feedback. Connection-level failures
         evict immediately (the process is gone — waiting out the
         heartbeat just fails more requests); HTTP-level failures only
         count (the monitor decides on readiness). A request TIMEOUT
-        (socket.timeout is an OSError) means slow, not dead — one
-        pathological request must not cascade-evict replicas that
-        still answer /healthz, so the heartbeat monitor owns that
-        verdict."""
+        (socket.timeout is an OSError) means slow, not dead — ONE
+        pathological request must not evict a replica that still
+        answers /healthz. Instead it marks the replica SUSPECT
+        (deprioritized) and feeds its circuit breaker; after
+        `breaker_threshold` CONSECUTIVE timeouts the breaker opens and
+        evicts the hung-but-TCP-alive member the heartbeat path cannot
+        see. Readmission then goes through the breaker's half-open
+        /readyz probe (`_probe`).
+
+        `breaker_eligible=False` marks a timeout whose wait window was
+        an impatient deadline SLICE, not a fair request_timeout: it
+        still fails this attempt and triggers a retry, but says nothing
+        reliable about the replica — a client hammering tiny
+        `X-Deadline-Ms` budgets must not be able to trip breakers and
+        evict healthy members."""
+        opened = False
+        is_timeout = isinstance(exc, TimeoutError)
         with self._lock:
             rep.failures += 1
-        if isinstance(exc, OSError) and not isinstance(exc, TimeoutError):
+            if is_timeout:
+                self._m_timeouts.inc()
+                if not breaker_eligible:
+                    return
+                opened = rep.breaker.record_timeout()
+                if rep.state == READY:
+                    rep.state = SUSPECT
+        if is_timeout:
+            if opened:
+                self._m_breaker_opens.inc()
+                self._evict(rep, "circuit breaker open after "
+                            f"{rep.breaker.threshold} consecutive "
+                            "request timeouts")
+        elif isinstance(exc, OSError):
             self._evict(rep, f"connection failure: {exc}")
+
+    def note_request_success(self, rep: FleetReplica) -> None:
+        """A completed request closes the replica's breaker and clears
+        a SUSPECT verdict — suspicion is about request progress, and
+        the request just progressed."""
+        with self._lock:
+            rep.failures = 0
+            rep.breaker.record_success()
+            if rep.state == SUSPECT:
+                rep.state = READY
 
     # ------------------------------------------------------- dispatch
     def ready_replicas(self) -> List[FleetReplica]:
@@ -565,17 +858,48 @@ class Fleet:
                 counts[r.state] += 1
             return counts
 
+    def breaker_counts(self) -> Dict[str, int]:
+        with self._lock:
+            counts = {CircuitBreaker.CLOSED: 0,
+                      CircuitBreaker.HALF_OPEN: 0,
+                      CircuitBreaker.OPEN: 0}
+            for r in self._replicas.values():
+                counts[r.breaker.state] += 1
+            return counts
+
     def select(self, route: str = "predict",
                exclude: Sequence[str] = ()) -> FleetReplica:
         """Least-outstanding READY replica (round-robin tiebreak) —
-        the ReplicaSet policy lifted across processes. Sheds with
-        OverloadedError past the global high-water mark; raises
-        NoReadyReplicas when nothing is admittable. The caller owns
-        `release()`."""
+        the ReplicaSet policy lifted across processes. SUSPECT
+        replicas (recent request timeouts, breaker not yet open) stay
+        in the pool but rank AFTER any equally-loaded READY peer:
+        under load their in-flight hangs pile up `outstanding` so real
+        traffic skews to healthy members, while the trickle they still
+        receive is exactly what either clears the suspicion (a
+        success) or trips the breaker (N consecutive timeouts) — a
+        suspect starved of all traffic could never resolve either way.
+        Under idle/sequential traffic even the deprioritized rank would
+        starve a suspect (every peer sits at outstanding 0), so
+        suspicion additionally DECAYS back to READY after a quiet
+        `breaker_reset_s` — the replica re-enters the tiebreak rotation
+        and the next request delivers the breaker its verdict either
+        way. Sheds with OverloadedError past the global high-water
+        mark; raises NoReadyReplicas when nothing is admittable. The
+        caller owns `release()`."""
         with self._lock:
+            now = time.monotonic()
+            for r in self._replicas.values():
+                if (r.state == SUSPECT
+                        and r.breaker.last_timeout_at is not None
+                        and now - r.breaker.last_timeout_at
+                        >= r.breaker.reset_s):
+                    # decay does NOT reset the consecutive-timeout
+                    # streak: only a completed request proves progress
+                    r.state = READY
             ids = list(self._replicas)
             ready = [r for r in self._replicas.values()
-                     if r.state == READY and r.id not in exclude]
+                     if r.state in (READY, SUSPECT)
+                     and r.id not in exclude]
             if not ready:
                 raise NoReadyReplicas(
                     f"no ready replica (states: {self.state_counts()})")
@@ -590,7 +914,8 @@ class Fleet:
                         retry_after_ms=200)
             n = len(ids)
             best = min(ready, key=lambda r: (
-                r.outstanding, (ids.index(r.id) - self._rr) % n))
+                r.outstanding, r.state == SUSPECT,
+                (ids.index(r.id) - self._rr) % n))
             self._rr = (ids.index(best.id) + 1) % n
             best.outstanding += 1
             if not exclude:
@@ -608,20 +933,36 @@ class Fleet:
     def observe(self, route: str, seconds: float) -> None:
         self._m_latency[route].observe(seconds)
 
-    def forward_predict(self, body: bytes
+    def forward_predict(self, body: bytes,
+                        deadline: Optional[Deadline] = None
                         ) -> Tuple[int, dict, bytes]:
         """Route one /predict: least-loaded replica, transparent retry
-        on a healthy peer after connection failures or replica 5xx
-        (idempotent, so at-least-once is safe). Returns (status,
-        headers, body) from the replica that answered."""
+        on a healthy peer after connection failures, request timeouts,
+        or replica 5xx (idempotent, so at-least-once is safe) — under
+        the fleet's explicit `retry_budget`. With a `deadline`, each
+        hop's socket timeout is a SLICE of the remaining budget
+        (remaining / attempts-left, capped by request_timeout) so a
+        hung replica spends one slice and leaves room to retry, and
+        the shrunk budget is forwarded downstream as `X-Deadline-Ms`.
+        Returns (status, headers, body) from the replica that
+        answered."""
         start = time.perf_counter()
         tried: set = set()
         last_5xx: Optional[Tuple[int, dict, bytes]] = None
         last_err: Optional[BaseException] = None
         try:
+            if deadline is not None and deadline.expired:
+                # shed before any replica is touched: machine-readable
+                # 504, no compute anywhere
+                self._m_deadline["predict"].inc()
+                deadline.check("router dispatch")
             with self._lock:
-                attempts = max(1, len(self._replicas))
-            for _ in range(attempts):
+                attempts = max(1, min(len(self._replicas),
+                                      1 + self.retry_budget))
+            for attempt in range(attempts):
+                if deadline is not None and deadline.expired:
+                    self._m_deadline["predict"].inc()
+                    deadline.check("router retry")
                 try:
                     rep = self.select(route="predict", exclude=tried)
                 except NoReadyReplicas:
@@ -630,12 +971,35 @@ class Fleet:
                     # a retry is an attempt actually MADE on a peer
                     # after a failure, not the failure itself
                     self._m_retries.inc()
+                if deadline is None:
+                    hop_timeout = self.request_timeout
+                    headers = None
+                else:
+                    hop_timeout = max(0.05, min(
+                        self.request_timeout,
+                        deadline.remaining_s() / (attempts - attempt)))
+                    # forward the HOP's own window, not the whole
+                    # remaining budget: once the router stops waiting
+                    # and replays on a peer, the first replica's
+                    # admission gates shed the abandoned work instead
+                    # of computing an answer nobody will read
+                    headers = {DEADLINE_HEADER:
+                               str(max(1, int(hop_timeout * 1000)))}
+                # a timeout at a deadline-sliced window shorter than a
+                # fair request_timeout says the CLIENT was impatient,
+                # not that the replica hung — it must not feed the
+                # breaker (min() with probe_timeout keeps short
+                # explicitly-configured request_timeouts eligible)
+                fair_window = min(self.request_timeout,
+                                  self.probe_timeout)
                 try:
-                    status, headers, data = rep.client.request(
+                    status, hdrs, data = rep.client.request(
                         "POST", "/predict", body,
-                        timeout=self.request_timeout)
+                        timeout=hop_timeout, headers=headers)
                 except Exception as e:
-                    self.note_request_failure(rep, e)
+                    self.note_request_failure(
+                        rep, e,
+                        breaker_eligible=hop_timeout >= fair_window)
                     tried.add(rep.id)
                     last_err = e
                     continue
@@ -645,9 +1009,10 @@ class Fleet:
                     # replica answered but failed/shed: try a peer,
                     # keep the reply in case every peer does the same
                     tried.add(rep.id)
-                    last_5xx = (status, headers, data)
+                    last_5xx = (status, hdrs, data)
                     continue
-                return status, headers, data
+                self.note_request_success(rep)
+                return status, hdrs, data
             if last_5xx is not None:
                 return last_5xx
             raise NoReadyReplicas(
@@ -741,7 +1106,12 @@ class Fleet:
                 retry_after_ms=5000)
         self._reload_active = True
         try:
-            targets = self.ready_replicas()
+            # SUSPECT replicas route traffic too (select() admits
+            # them), so they MUST be reloaded — skipping one would
+            # leave it serving the old checkpoint indefinitely
+            with self._lock:
+                targets = [r for r in self._replicas.values()
+                           if r.state in (READY, SUSPECT)]
             if not targets:
                 raise NoReadyReplicas("no ready replicas to reload")
             rollback = (rollback_path if rollback_path is not None
@@ -849,7 +1219,7 @@ class Fleet:
             return 0  # never resize mid-reload
         with self._lock:
             live = [r for r in self._replicas.values()
-                    if r.state in (READY, STARTING)]
+                    if r.state in (READY, SUSPECT, STARTING)]
             outstanding = sum(r.outstanding
                               for r in self._replicas.values())
         delta = self.autoscaler.decide(len(live), outstanding)
@@ -880,13 +1250,20 @@ class Fleet:
         return {
             "replicas": reps,
             "states": self.state_counts(),
+            "breakers": self.breaker_counts(),
             "outstanding": self.total_outstanding(),
             "shed_high_water": self.shed_high_water,
             "current_checkpoint": self.current_checkpoint,
             "rolling_reload_active": self._reload_active,
+            "retry_budget": self.retry_budget,
             "requests": {route: int(c.value)
                          for route, c in self._m_requests.items()},
             "retries": int(self._m_retries.value),
+            "request_timeouts": int(self._m_timeouts.value),
+            "breaker_opens": int(self._m_breaker_opens.value),
+            "deadline_exceeded": {route: int(c.value)
+                                  for route, c in
+                                  self._m_deadline.items()},
             "shed": {route: int(c.value)
                      for route, c in self._m_shed.items()},
             "evictions": int(self._m_evictions.value),

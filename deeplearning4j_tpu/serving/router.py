@@ -46,8 +46,13 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler
 from typing import Optional, Tuple
 
-from deeplearning4j_tpu.serving.errors import OverloadedError, overload_body
+from deeplearning4j_tpu.serving.errors import (DEADLINE_HEADER, Deadline,
+                                               DeadlineExceededError,
+                                               OverloadedError,
+                                               deadline_body,
+                                               overload_body)
 from deeplearning4j_tpu.telemetry import exposition
+from deeplearning4j_tpu.testing import chaos
 from deeplearning4j_tpu.utils.httpd import ServerHandle, start_http_server
 
 __all__ = ["ReplicaClient", "FleetHandle", "serve_fleet"]
@@ -70,18 +75,22 @@ class ReplicaClient:
 
     # ------------------------------------------------------------- raw
     def open(self, method: str, path: str, body: Optional[bytes] = None,
-             timeout: Optional[float] = None):
+             timeout: Optional[float] = None,
+             headers: Optional[dict] = None):
         """Issue a request and return (connection, response) with the
         body NOT yet read — the streaming proxy relays it chunk by
-        chunk. The caller owns `connection.close()`."""
+        chunk. The caller owns `connection.close()`. `headers` extends
+        the defaults (how the router forwards `X-Deadline-Ms`)."""
         import http.client
 
         conn = http.client.HTTPConnection(
             self.host, self.port,
             timeout=self.timeout if timeout is None else timeout)
-        headers = {"Content-Type": "application/json"} if body else {}
+        hdrs = {"Content-Type": "application/json"} if body else {}
+        if headers:
+            hdrs.update(headers)
         try:
-            conn.request(method, path, body=body, headers=headers)
+            conn.request(method, path, body=body, headers=hdrs)
             resp = conn.getresponse()
         except BaseException:
             conn.close()
@@ -90,10 +99,12 @@ class ReplicaClient:
 
     def request(self, method: str, path: str,
                 body: Optional[bytes] = None,
-                timeout: Optional[float] = None
+                timeout: Optional[float] = None,
+                headers: Optional[dict] = None
                 ) -> Tuple[int, dict, bytes]:
         """One whole request: (status, headers-dict, body-bytes)."""
-        conn, resp = self.open(method, path, body, timeout)
+        conn, resp = self.open(method, path, body, timeout,
+                               headers=headers)
         try:
             data = resp.read()
             return resp.status, dict(resp.getheaders()), data
@@ -226,6 +237,7 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             length = int(self.headers.get("Content-Length") or 0)
             self._body = self.rfile.read(length) if length > 0 else None
             try:
+                chaos.hit("router.forward", path=self.path)
                 if self.path.startswith("/predict"):
                     self._predict()
                 elif self.path.startswith("/generate"):
@@ -238,6 +250,10 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                     self._reply(404, {"error": f"no route {self.path}"})
             except OverloadedError as e:
                 self._reply_overloaded(e)
+            except DeadlineExceededError as e:
+                # the machine-readable budget-spent shape — same wire
+                # contract as the replica server's 504
+                self._reply(504, deadline_body(e))
             except NoReadyReplicas as e:
                 self._reply(503, {"error": "no_ready_replicas",
                                   "detail": str(e)},
@@ -258,7 +274,11 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
         def _predict(self):
             if self._body is None:
                 raise ValueError("missing request body")
-            status, headers, data = fleet.forward_predict(self._body)
+            # header-borne budget (clients of the router speak the
+            # header; the router forwards the SHRUNK remainder)
+            deadline = Deadline.from_request(self.headers)
+            status, headers, data = fleet.forward_predict(
+                self._body, deadline=deadline)
             ctype = headers.get("Content-Type", "application/json")
             extra = [("Retry-After", headers["Retry-After"])] \
                 if "Retry-After" in headers else []
@@ -271,23 +291,42 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             self.wfile.write(data)
 
         def _generate(self):
-            data = self._read_json()  # parsed only for the stream flag
+            data = self._read_json()  # parsed for stream/deadline
             streaming = bool(data.get("stream", False))
+            deadline = Deadline.from_request(self.headers, data)
+            if deadline is not None and deadline.expired:
+                fleet._m_deadline["generate"].inc()
+                deadline.check("router dispatch")  # raises -> 504
             replica = fleet.select(route="generate")
             start = time.perf_counter()
             import http.client as _hc
 
             replica_errs = (OSError, _hc.HTTPException)
             try:
+                if deadline is None:
+                    hop_timeout, fwd_headers = fleet.generate_timeout, None
+                else:
+                    # generate is never replayed, so the whole remaining
+                    # budget rides this one hop
+                    hop_timeout = deadline.timeout(fleet.generate_timeout)
+                    fwd_headers = {DEADLINE_HEADER:
+                                   deadline.header_value()}
+                # a timeout at a deadline-sliced window shorter than a
+                # fair wait says the CLIENT was impatient, not that the
+                # replica hung — same eligibility rule forward_predict
+                # applies (fleet.note_request_failure's contract)
+                eligible = hop_timeout >= min(fleet.generate_timeout,
+                                              fleet.probe_timeout)
                 try:
                     conn, resp = replica.client.open(
                         "POST", "/generate", self._body,
-                        timeout=fleet.generate_timeout)
+                        timeout=hop_timeout, headers=fwd_headers)
                 except replica_errs as e:
                     # failed before any byte reached the client: fail
                     # FAST with a structured, retryable error (the
                     # router never replays a generate itself)
-                    fleet.note_request_failure(replica, e)
+                    fleet.note_request_failure(replica, e,
+                                               breaker_eligible=eligible)
                     self._reply(502, {
                         "error": "replica_failed",
                         "replica": replica.id,
@@ -296,20 +335,24 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                     return
                 try:
                     if streaming and resp.status == 200:
-                        self._relay_stream(replica, resp)
+                        self._relay_stream(replica, resp,
+                                           breaker_eligible=eligible)
                         return
                     try:
                         body = resp.read()
                     except replica_errs as e:
                         # replica died mid-body; the client has seen
                         # nothing yet, so the structured 502 still fits
-                        fleet.note_request_failure(replica, e)
+                        fleet.note_request_failure(
+                            replica, e, breaker_eligible=eligible)
                         self._reply(502, {
                             "error": "replica_failed",
                             "replica": replica.id,
                             "detail": f"{type(e).__name__}: {e}",
                             "retryable": True})
                         return
+                    if resp.status < 500:
+                        fleet.note_request_success(replica)
                     extra = []
                     ra = resp.getheader("Retry-After")
                     if ra:
@@ -330,7 +373,8 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                 fleet.release(replica)
                 fleet.observe("generate", time.perf_counter() - start)
 
-        def _relay_stream(self, replica, resp) -> None:
+        def _relay_stream(self, replica, resp,
+                          breaker_eligible: bool = True) -> None:
             """Chunked NDJSON passthrough; a mid-stream replica failure
             is reported in-band (headers are long gone). Replica reads
             and client writes fail SEPARATELY: only a replica-side
@@ -353,7 +397,8 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                     try:
                         piece = resp.readline()  # http.client de-chunks
                     except Exception as e:  # replica died mid-stream
-                        fleet.note_request_failure(replica, e)
+                        fleet.note_request_failure(
+                            replica, e, breaker_eligible=breaker_eligible)
                         chunk((json.dumps({
                             "error": "replica_failed",
                             "replica": replica.id,
@@ -361,6 +406,7 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                             + "\n").encode())
                         break
                     if not piece:
+                        fleet.note_request_success(replica)
                         break
                     chunk(piece)
                 self.wfile.write(b"0\r\n\r\n")

@@ -56,6 +56,7 @@ import json
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from http.server import BaseHTTPRequestHandler
 from typing import Optional
 
@@ -63,18 +64,32 @@ import numpy as np
 
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.serving.engine import InferenceEngine
-from deeplearning4j_tpu.serving.errors import OverloadedError, overload_body
+from deeplearning4j_tpu.serving.errors import (Deadline,
+                                               DeadlineExceededError,
+                                               OverloadedError,
+                                               deadline_body,
+                                               overload_body)
 from deeplearning4j_tpu.serving.replicas import ReplicaSet
 from deeplearning4j_tpu.telemetry import exposition
+from deeplearning4j_tpu.testing import chaos
 from deeplearning4j_tpu.utils.httpd import ServerHandle, start_http_server
 
 __all__ = ["ServingHandle", "serve_network"]
 
 _M_RELOADS = telemetry.counter(
     "dl4j_serve_reloads", "hot checkpoint reloads applied to the replicas")
+_M_DEADLINE = telemetry.counter(
+    "dl4j_serve_deadline_exceeded",
+    "requests answered 504 because their deadline budget was spent")
+_M_DISCONNECTS = telemetry.counter(
+    "dl4j_serve_client_disconnects",
+    "streaming clients that hung up mid-/generate (their slots were "
+    "cancelled and their KV pages freed)")
 
 #: per-request wait on the batcher future — generous; the batcher bounds
-#: queueing at max_delay_ms, so hitting this means the engine died
+#: queueing at max_delay_ms, so hitting this means the engine died.
+#: Requests carrying a deadline derive their wait from the REMAINING
+#: budget instead (docs/SERVING.md "Deadlines").
 _RESULT_TIMEOUT_S = 120.0
 
 
@@ -286,7 +301,33 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
             except Exception as e:  # always answer with a status line
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
+        def _reset_connection(self) -> None:
+            """Abort the client connection with an RST (SO_LINGER 0),
+            not a clean FIN — the injected "reset" socket fault."""
+            import socket as _socket
+            import struct as _struct
+
+            try:
+                self.connection.setsockopt(
+                    _socket.SOL_SOCKET, _socket.SO_LINGER,
+                    _struct.pack("ii", 1, 0))
+            except OSError:
+                pass
+            self.close_connection = True
+            try:
+                self.connection.close()
+            except OSError:
+                pass
+
         def do_POST(self):
+            try:
+                # accept-then-hang / pre-read faults: the request is
+                # accepted but the handler goes dark before reading or
+                # answering anything (chaos "hang"/"delay"/"reset")
+                chaos.hit("server.accept", path=self.path)
+            except chaos.ChaosReset:
+                self._reset_connection()
+                return
             # slurp the body up front, before ANY reply: under
             # HTTP/1.1 keep-alive an unread body would desync the
             # connection — the leftover bytes parse as the client's
@@ -294,6 +335,9 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
             length = int(self.headers.get("Content-Length") or 0)
             self._body = self.rfile.read(length) if length > 0 else None
             try:
+                # slow-loris-shaped handler stall: body read, reply
+                # withheld (chaos "delay"; errors surface as 500s)
+                chaos.hit("server.read", path=self.path)
                 if self.path.startswith("/predict"):
                     self._predict()
                 elif self.path.startswith("/generate"):
@@ -302,6 +346,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                     self._reload()
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
+            except chaos.ChaosReset:
+                self._reset_connection()
             except FileNotFoundError as e:
                 self._reply(404, {"error": str(e)})
             except OverloadedError as e:
@@ -314,6 +360,11 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            except DeadlineExceededError as e:
+                # the machine-readable twin for spent time budgets:
+                # 504 + {"error": "deadline_exceeded", ...}
+                _M_DEADLINE.inc()
+                self._reply(504, deadline_body(e))
             except (ValueError, KeyError, TypeError) as e:
                 self._reply(400, {"error": str(e)})
             except Exception as e:  # engine-side failure
@@ -321,9 +372,30 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
 
         def _predict(self):
             data = self._read_json()
+            deadline = Deadline.from_request(self.headers, data)
+            chaos.hit("server.predict")
             inputs = np.asarray(data["inputs"], np.float32)
-            fut: Future = batcher.submit(inputs)
-            out = fut.result(timeout=_RESULT_TIMEOUT_S)
+            # batcher.submit sheds an already-expired budget before
+            # enqueueing, and re-checks at dispatch
+            fut: Future = batcher.submit(inputs, deadline=deadline)
+            wait_s = (_RESULT_TIMEOUT_S if deadline is None
+                      else deadline.timeout(_RESULT_TIMEOUT_S))
+            try:
+                out = fut.result(timeout=wait_s)
+            except (FutureTimeoutError, TimeoutError):
+                # abandon the future: if it is still queued, the
+                # batcher drops it at dispatch instead of computing
+                # an answer nobody is waiting for. Only a genuinely
+                # SPENT budget becomes a 504 — a wait that hit the
+                # engine-death backstop with budget remaining is an
+                # engine failure (500), not the client's fault
+                fut.cancel()
+                if deadline is not None and deadline.expired:
+                    raise DeadlineExceededError(
+                        "deadline exceeded waiting for the batcher",
+                        deadline_ms=deadline.budget_ms,
+                        elapsed_ms=deadline.elapsed_ms()) from None
+                raise
             self._reply(200, {
                 "outputs": np.asarray(out).tolist(),
                 "classes": np.argmax(out, axis=-1).astype(int).tolist(),
@@ -349,6 +421,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                 self._reply(404, {"error": "no generate engine configured"})
                 return
             data = self._read_json()
+            deadline = Deadline.from_request(self.headers, data)
+            chaos.hit("server.generate")
             raw = data["prompt"]
             if not isinstance(raw, list) or not raw:
                 raise ValueError("prompt must be a non-empty token list "
@@ -376,6 +450,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                     raise ValueError(
                         "eos_id/stream need the continuous-batching "
                         "decode loop (serve with slots >= 1)")
+                if deadline is not None:
+                    deadline.check("generate")  # 504 before compute
                 out = generate_engine.generate(np.asarray(prompt),
                                                max_tokens)
                 self._reply(200, {"tokens": out.astype(int).tolist()})
@@ -383,42 +459,130 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
             # all-or-nothing admission: a malformed row 400s and an
             # admission shed 503s WITHOUT orphaning row-mates' streams
             # in running slots (submit_many validates every row, then
-            # enqueues the whole group under one lock)
-            streams = loop.submit_many(prompt, max_tokens, eos_id)
+            # enqueues the whole group under one lock); an expired
+            # deadline 504s at submit, and again at slot admission
+            streams = loop.submit_many(prompt, max_tokens, eos_id,
+                                       deadline=deadline)
             if streaming:
-                self._stream_tokens(streams)
+                self._stream_tokens(streams, deadline)
                 return
-            rows = [s.full_sequence(_RESULT_TIMEOUT_S) for s in streams]
+            wait_s = (_RESULT_TIMEOUT_S if deadline is None
+                      else deadline.timeout(_RESULT_TIMEOUT_S))
+            try:
+                rows = [s.full_sequence(wait_s) for s in streams]
+            except BaseException as e:
+                # deadline/timeout/error on any row: retire the whole
+                # group's slots so no abandoned stream burns pages
+                for s in streams:
+                    s.cancel()
+                if (deadline is not None and deadline.expired
+                        and isinstance(e, TimeoutError)):
+                    # the wall wait and the loop's own reap race; the
+                    # client-visible verdict is the same either way
+                    raise DeadlineExceededError(
+                        "deadline exceeded waiting for generation",
+                        deadline_ms=deadline.budget_ms,
+                        elapsed_ms=deadline.elapsed_ms()) from None
+                raise
             self._reply(200, {
                 "tokens": rows,
                 "finish_reasons": [s.finish_reason for s in streams],
             })
 
-        def _stream_tokens(self, streams):
+        def _stream_tokens(self, streams, deadline=None):
             """Chunked NDJSON: one line per emitted token, as the slots
             emit them, then a final summary line. The client sees
-            first-token latency, not last-token latency."""
+            first-token latency, not last-token latency.
+
+            Every abnormal exit CANCELS the request's streams — a
+            disconnected (or reset, or timed-out) client must not leave
+            slots decoding into the void: cancellation retires them and
+            frees their KV pages within one scheduler dispatch."""
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
 
             def chunk(obj) -> None:
+                chaos.hit("generate.midstream")
                 body = (json.dumps(obj) + "\n").encode()
                 self.wfile.write(f"{len(body):x}\r\n".encode()
                                  + body + b"\r\n")
                 self.wfile.flush()
 
             try:
-                self._relay_streams(streams, chunk)
+                self._relay_streams(streams, chunk, deadline)
+            except chaos.ChaosReset:
+                for s in streams:
+                    s.cancel()
+                self._reset_connection()
+                return
+            except DeadlineExceededError as e:
+                # the decode loop's reap retired the slot on a spent
+                # budget (the PRIMARY mid-stream enforcement): keep
+                # the machine-readable wire shape in-band
+                _M_DEADLINE.inc()
+                for s in streams:
+                    s.cancel()
+                try:
+                    chunk(deadline_body(e))
+                except Exception:
+                    self.close_connection = True
+                    return
+            except TimeoutError as e:
+                # a stalled wait, NOT a disconnect (TimeoutError IS-A
+                # OSError, so this arm must come first): the client is
+                # still connected — cancel the slots and say why
+                # in-band, with the machine-readable deadline shape
+                # when a budget ran out
+                for s in streams:
+                    s.cancel()
+                if deadline is not None and deadline.expired:
+                    _M_DEADLINE.inc()
+                    err = deadline_body(DeadlineExceededError(
+                        "deadline exceeded mid-stream",
+                        deadline_ms=deadline.budget_ms,
+                        elapsed_ms=deadline.elapsed_ms()))
+                else:
+                    err = {"error": f"TimeoutError: {e}"}
+                try:
+                    chunk(err)
+                except Exception:
+                    self.close_connection = True
+                    return
+            except OSError:
+                # the client hung up mid-stream: nothing left to tell
+                # it — just stop burning its slots
+                _M_DISCONNECTS.inc()
+                for s in streams:
+                    s.cancel()
+                self.close_connection = True
+                return
             except Exception as e:  # headers are gone — report in-band
-                chunk({"error": f"{type(e).__name__}: {e}"})
-            self.wfile.write(b"0\r\n\r\n")
+                for s in streams:
+                    s.cancel()
+                try:
+                    chunk({"error": f"{type(e).__name__}: {e}"})
+                except Exception:
+                    self.close_connection = True
+                    return
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
             self.close_connection = True
 
-        def _relay_streams(self, streams, chunk) -> None:
+        def _relay_streams(self, streams, chunk, deadline=None) -> None:
+            # the per-wait backstop: budget-carrying requests bound
+            # every wait by their REMAINING budget (the decode loop's
+            # reap is the primary enforcement; this covers a stalled
+            # scheduler), budget-less ones keep the legacy constant
+            def wait_s() -> float:
+                return (_RESULT_TIMEOUT_S if deadline is None
+                        else deadline.timeout(_RESULT_TIMEOUT_S))
+
             if len(streams) == 1:  # common case: emit inline
-                for tok in streams[0].tokens(timeout=_RESULT_TIMEOUT_S):
+                for tok in streams[0].tokens(timeout=wait_s()):
                     chunk({"row": 0, "token": int(tok)})
             else:  # merge rows as they emit, one relay thread per slot
                 import queue as _queue
@@ -428,7 +592,7 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
 
                 def relay(r, s):
                     try:
-                        for tok in s.tokens(timeout=_RESULT_TIMEOUT_S):
+                        for tok in s.tokens(timeout=wait_s()):
                             merged.put((r, int(tok)))
                     except Exception:
                         pass  # surfaced via finish_reason below
@@ -448,7 +612,7 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                     else:
                         chunk({"row": r, "token": tok})
             chunk({"done": True,
-                   "tokens": [s.prompt + s.result(_RESULT_TIMEOUT_S)
+                   "tokens": [s.prompt + s.result(wait_s())
                               if s.error is None else None
                               for s in streams],
                    "finish_reasons": [s.finish_reason for s in streams]})

@@ -44,6 +44,12 @@ def pytest_configure(config):
         "markers",
         "slow: long soak/drill tests excluded from tier-1 (which runs "
         "-m 'not slow'); run explicitly with pytest -m slow")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection drills (deeplearning4j_tpu.testing."
+        "chaos); the fast deterministic subset runs in tier-1, the "
+        "randomized soak and real-process SIGSTOP drills also carry "
+        "@slow — run the whole layer with pytest -m chaos")
 
 
 def pytest_collection_modifyitems(config, items):

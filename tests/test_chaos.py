@@ -1,0 +1,1247 @@
+"""Chaos harness + end-to-end deadlines, cancellation, and hung-replica
+defense (ISSUE 8 acceptance).
+
+The contracts under test:
+
+1. **Deterministic injection** (`deeplearning4j_tpu.testing.chaos`):
+   a seeded `ChaosPlan` fires the same faults at the same point-local
+   hit ordinals every run, whatever the thread interleaving, and
+   `replay_rules()` reproduces a recorded schedule exactly — a failing
+   randomized soak is replayable from its failure log.
+2. **Deadlines**: an already-expired `deadline_ms` is shed at EVERY
+   admission point — router dispatch, batcher submit AND dispatch,
+   decode-loop submit AND slot admission — with the machine-readable
+   `deadline_exceeded` shape and WITHOUT reaching a compiled step
+   (pinned by the program-cache and dispatch counters).
+3. **Cancellation**: `GenerationStream.cancel()` (and the client
+   disconnect / mid-stream reset paths that use it) retires the slot
+   and returns its KV pages to the pool within one scheduler dispatch.
+4. **Hung-replica defense**: request timeouts mark a replica SUSPECT
+   and feed its circuit breaker; `breaker_threshold` consecutive
+   timeouts evict the hung-but-TCP-alive member the heartbeat path
+   cannot see, and readmission goes through the breaker's half-open
+   `/readyz` probe. The flagship SIGSTOP drill (suspect → breaker-open
+   → evict → SIGCONT → half-open readmit) runs on REAL spawned replica
+   processes under `-m slow`; its deterministic fake-replica twin runs
+   in tier-1.
+
+Run the whole layer with `pytest -m chaos`; the randomized soak and the
+real-process drills also carry `@slow` (docs/FAULT_TOLERANCE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import (Deadline, DeadlineExceededError,
+                                        Fleet, MicroBatcher, serve_network)
+from deeplearning4j_tpu.serving.fleet import (EVICTED, READY, SUSPECT,
+                                              CircuitBreaker)
+from deeplearning4j_tpu.testing import chaos
+from deeplearning4j_tpu.testing.chaos import ChaosError, ChaosPlan, Rule
+from deeplearning4j_tpu.utils.httpd import start_http_server
+
+pytestmark = pytest.mark.chaos
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """Every test leaves the process chaos-free: an injection plan that
+    outlives its test would fire inside unrelated tests."""
+    yield
+    chaos.deactivate()
+
+
+def _post(url, payload, timeout=60, headers=()):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(dict(headers))
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 headers=hdrs)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _net(n_in=4, n_out=3):
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(n_in).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=n_out)
+            .pretrain(False).build())
+    return MultiLayerNetwork(conf)
+
+
+# ===================================================== the chaos registry
+class TestChaosPlan:
+    def test_seeded_schedule_is_deterministic(self):
+        """Same spec + seed -> identical firing schedule, run to run."""
+        def run():
+            plan = ChaosPlan([Rule("p.a", "error", prob=0.3),
+                              Rule("p.b", "delay", prob=0.5,
+                                   delay_s=0.0)], seed=42)
+            fired = []
+            for i in range(60):
+                point = "p.a" if i % 2 == 0 else "p.b"
+                try:
+                    if plan.decide(point) is not None:
+                        fired.append((point, i))
+                except ChaosError:  # pragma: no cover
+                    fired.append((point, i))
+            # drop wall-clock timestamps: the schedule is what must be
+            # deterministic, not how fast the loop ran
+            log = [{k: v for k, v in e.items() if k != "t_s"}
+                   for e in plan.log()]
+            return fired, log
+        a, la = run()
+        b, lb = run()
+        assert a == b and la == lb
+        assert len(a) > 0  # the probabilities actually fire
+
+    def test_ordinals_are_point_local_and_interleaving_free(self):
+        """A rule's decision depends only on ITS point's hit ordinal:
+        hammering an unrelated point between hits changes nothing."""
+        plan1 = ChaosPlan([Rule("p.x", "error", prob=0.4)], seed=7)
+        sched1 = [plan1.decide("p.x") is not None for _ in range(40)]
+        plan2 = ChaosPlan([Rule("p.x", "error", prob=0.4)], seed=7)
+        sched2 = []
+        for _ in range(40):
+            for _ in range(3):
+                plan2.decide("p.noise")  # unrelated traffic
+            sched2.append(plan2.decide("p.x") is not None)
+        assert sched1 == sched2
+
+    def test_at_times_after_semantics(self):
+        plan = ChaosPlan([Rule("p", "error", at=[1, 3])])
+        hits = [plan.decide("p") is not None for _ in range(5)]
+        assert hits == [False, True, False, True, False]
+
+        plan = ChaosPlan([Rule("p", "error", times=2)])
+        hits = [plan.decide("p") is not None for _ in range(5)]
+        assert hits == [True, True, False, False, False]
+
+        plan = ChaosPlan([Rule("p", "error", after=2)])
+        hits = [plan.decide("p") is not None for _ in range(4)]
+        assert hits == [False, False, True, True]
+
+    def test_replay_reproduces_recorded_schedule_exactly(self):
+        """ISSUE CI satellite: a randomized schedule replays bit-for-bit
+        from its failure log via exact-ordinal `at=` rules."""
+        plan = ChaosPlan([Rule("p.a", "error", prob=0.35),
+                          Rule("p.b", "error", prob=0.2)], seed=11)
+        recorded = []
+        for i in range(80):
+            point = ("p.a", "p.b")[i % 2]
+            if plan.decide(point) is not None:
+                recorded.append((point, i))
+        assert recorded  # something fired
+        replay = ChaosPlan(plan.replay_rules(), seed=999)  # seed moot
+        replayed = []
+        for i in range(80):
+            point = ("p.a", "p.b")[i % 2]
+            if replay.decide(point) is not None:
+                replayed.append((point, i))
+        assert replayed == recorded
+
+    def test_env_spec_round_trips_the_plan(self):
+        """`env_spec` -> `DL4J_TPU_CHAOS` -> a fresh process's plan:
+        how spawned replicas join a drill (exercised for real by the
+        SIGSTOP/soak drills; here the serialization contract)."""
+        env = chaos.env_spec([Rule("p", "error", at=[0], message="boom"),
+                              Rule("q", "delay", prob=0.5,
+                                   delay_s=0.01)], seed=5)
+        spec = json.loads(env[chaos.ENV_VAR])
+        back = ChaosPlan(spec["rules"], seed=spec["seed"])
+        assert back.seed == 5
+        assert back.rules[0].at == frozenset([0])
+        assert back.rules[0].message == "boom"
+        assert back.rules[1].prob == 0.5
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="kind"):
+            Rule("p", "frobnicate")
+        with pytest.raises(ValueError, match="prob"):
+            Rule("p", "error", prob=1.5)
+
+
+class TestChaosKinds:
+    def test_error_reset_and_nan_behaviours(self):
+        chaos.configure([Rule("a", "error", message="injected-a"),
+                         Rule("b", "reset")])
+        with pytest.raises(chaos.ChaosError, match="injected-a"):
+            chaos.hit("a")
+        with pytest.raises(chaos.ChaosReset):
+            chaos.hit("b")
+        # reset IS-A ChaosError so socketless sites handle it uniformly
+        assert issubclass(chaos.ChaosReset, chaos.ChaosError)
+
+    def test_hit_is_noop_without_plan(self):
+        assert chaos.active() is None or chaos.deactivate() is not None
+        assert chaos.hit("anything") is None
+
+    def test_delay_sleeps(self):
+        chaos.configure([Rule("d", "delay", delay_s=0.08)])
+        t0 = time.perf_counter()
+        assert chaos.hit("d") == "delay"
+        assert time.perf_counter() - t0 >= 0.07
+
+    def test_maybe_nan_poisons_float_arrays_only(self):
+        chaos.configure([Rule("n", "nan", times=2)])
+        x = np.ones((4, 4), np.float32)
+        out = chaos.maybe_nan("n", x)
+        assert np.isnan(out).any()
+        assert not np.isnan(x).any()       # the original is untouched
+        ints = np.ones((4,), np.int32)
+        assert not np.issubdtype(
+            chaos.maybe_nan("n", ints).dtype, np.floating)
+        chaos.deactivate()
+        same = np.ones(3, np.float32)
+        assert chaos.maybe_nan("n", same) is same  # no plan: identity
+
+    def test_firings_count_into_telemetry(self):
+        reg = telemetry.get_registry()
+        c = reg.counter("dl4j_chaos_injected",
+                        "faults injected by the chaos layer").labels(
+                            point="t.count", kind="error")
+        before = c.value
+        chaos.configure([Rule("t.count", "error", times=3)])
+        for _ in range(5):
+            with pytest.raises(chaos.ChaosError):
+                chaos.hit("t.count")
+            if chaos.active().fired() >= 3:
+                break
+        assert c.value == before + 3
+
+
+# ============================================================= deadlines
+class TestDeadline:
+    def test_constructors_and_expiry(self):
+        assert Deadline.from_ms(None) is None
+        d = Deadline.from_ms(0)       # legal, already expired: the
+        assert d.expired              # canonical shed-everywhere probe
+        with pytest.raises(ValueError, match=">= 0"):
+            Deadline.from_ms(-1)
+        d = Deadline.from_ms(60_000)
+        assert not d.expired
+        assert 59_000 < d.remaining_ms() <= 60_000
+
+    def test_check_raises_machine_readable(self):
+        d = Deadline.from_ms(0)
+        with pytest.raises(DeadlineExceededError) as ei:
+            d.check("the test")
+        assert ei.value.deadline_ms == 0
+        from deeplearning4j_tpu.serving.errors import deadline_body
+        body = deadline_body(ei.value)
+        assert body["error"] == "deadline_exceeded"
+        assert body["deadline_ms"] == 0 and "elapsed_ms" in body
+
+    def test_timeout_derivation_caps_and_floors(self):
+        d = Deadline.from_ms(60_000)
+        assert d.timeout(5.0) == 5.0          # capped by the default
+        d = Deadline.from_ms(200)
+        assert 0.05 <= d.timeout(30.0) <= 0.2  # the remaining budget
+        d = Deadline.from_ms(0)
+        assert d.timeout(30.0) == 0.05         # floored, never 0
+
+    def test_header_parsing_and_forwarding(self):
+        d = Deadline.from_request({"X-Deadline-Ms": "500"})
+        assert d is not None and d.budget_ms == 500
+        assert int(d.header_value()) >= 1     # never forwards as 0
+        d = Deadline.from_request({}, {"deadline_ms": 250})
+        assert d.budget_ms == 250
+        # the header wins over the body field
+        d = Deadline.from_request({"X-Deadline-Ms": "100"},
+                                  {"deadline_ms": 999})
+        assert d.budget_ms == 100
+        assert Deadline.from_request({}, {}) is None
+
+
+class TestBatcherDeadlines:
+    def test_expired_deadline_shed_at_submit_without_compute(self):
+        calls = []
+
+        def fwd(x):
+            calls.append(x.shape)
+            return x
+
+        with MicroBatcher(fwd, max_batch_size=8,
+                          max_delay_ms=1.0) as b:
+            with pytest.raises(DeadlineExceededError):
+                b.submit(np.ones((1, 4), np.float32),
+                         deadline=Deadline.from_ms(0))
+            assert b.snapshot()["deadline_exceeded"] == 1
+        assert calls == []  # the engine never ran
+
+    def test_queue_expired_deadline_shed_at_dispatch(self):
+        """A budget that dies WHILE QUEUED fails at dispatch without
+        engine work — pinned by the forward-call and batch counters."""
+        gate = threading.Event()
+        calls = []
+
+        def fwd(x):
+            calls.append(len(x))
+            gate.wait(timeout=30)  # hold the worker mid-batch
+            return x
+
+        b = MicroBatcher(fwd, max_batch_size=4, max_delay_ms=1.0)
+        try:
+            blocker = b.submit(np.ones((1, 4), np.float32))
+            while not calls:       # worker is inside fwd(blocker)
+                time.sleep(0.005)
+            doomed = b.submit(np.ones((1, 4), np.float32),
+                              deadline=Deadline.from_ms(30))
+            time.sleep(0.08)       # the queued budget dies
+            gate.set()
+            with pytest.raises(DeadlineExceededError,
+                               match="while queued"):
+                doomed.result(timeout=30)
+            blocker.result(timeout=30)
+            assert b.snapshot()["deadline_exceeded"] == 1
+        finally:
+            gate.set()
+            b.close()
+        assert calls == [1]  # ONLY the blocker reached the engine
+
+    def test_abandoned_future_dropped_at_dispatch(self):
+        gate = threading.Event()
+        calls = []
+
+        def fwd(x):
+            calls.append(len(x))
+            gate.wait(timeout=30)
+            return x
+
+        b = MicroBatcher(fwd, max_batch_size=4, max_delay_ms=1.0)
+        try:
+            blocker = b.submit(np.ones((1, 4), np.float32))
+            while not calls:
+                time.sleep(0.005)
+            abandoned = b.submit(np.ones((1, 4), np.float32))
+            assert abandoned.cancel()  # client gave up while queued
+            gate.set()
+            blocker.result(timeout=30)
+            b.close()                  # flush: the cancelled request
+            assert b.snapshot()["cancelled"] == 1
+        finally:
+            gate.set()
+            b.close()
+        assert calls == [1]
+
+
+# -------------------------------------------- decode-loop deadline gates
+@pytest.fixture(scope="module")
+def tf_setup():
+    import jax
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig, init_transformer_params)
+
+    cfg = TransformerConfig(vocab_size=17, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_len=64,
+                            interpret=True)
+    return init_transformer_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+class TestDecodeLoopDeadlines:
+    def test_expired_deadline_shed_at_submit(self, tf_setup):
+        from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
+
+        p, cfg = tf_setup
+        with DecodeLoop(p, cfg, slots=2, page_size=8) as loop:
+            with pytest.raises(DeadlineExceededError):
+                loop.submit([1, 2, 3], 4, deadline=Deadline.from_ms(0))
+            snap = loop.snapshot()
+        assert snap["deadline_exceeded"] == 1
+        assert snap["dispatches"] == 0       # no compiled step ran
+        assert snap["prefill_programs"] == 0  # nothing ever compiled
+
+    def test_queue_expired_deadline_shed_at_admission(self, tf_setup):
+        """ISSUE acceptance: a budget that dies while waiting for a
+        slot is shed at admission — the stream finishes with
+        `deadline_exceeded` and the dispatch/program counters prove no
+        compute started."""
+        from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
+
+        p, cfg = tf_setup
+        loop = DecodeLoop(p, cfg, slots=2, page_size=8, start=False)
+        try:
+            st = loop.submit([1, 2, 3], 4, deadline=Deadline.from_ms(20))
+            time.sleep(0.06)      # expires in the waiting queue
+            loop.tick()           # admission pass sheds it
+            with pytest.raises(DeadlineExceededError):
+                st.result(timeout=5)
+            assert st.finish_reason == "deadline_exceeded"
+            snap = loop.snapshot()
+            assert snap["deadline_exceeded"] == 1
+            assert snap["dispatches"] == 0
+            assert snap["prefill_programs"] == 0
+            assert snap["pages_in_use"] == 0
+        finally:
+            loop.close()
+
+    def test_mid_flight_expiry_reaped_and_pages_freed(self, tf_setup):
+        from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
+
+        p, cfg = tf_setup
+        loop = DecodeLoop(p, cfg, slots=1, page_size=8, start=False)
+        try:
+            st = loop.submit([1, 2, 3, 4, 5], 40,
+                             deadline=Deadline.from_ms(150))
+            loop.tick()  # admit + first dispatch: pages now held
+            assert loop.snapshot()["pages_in_use"] > 0
+            time.sleep(0.2)       # budget dies mid-generation
+            loop.tick()           # the reap pass retires the slot
+            assert st.finish_reason == "deadline_exceeded"
+            assert loop.snapshot()["pages_in_use"] == 0
+        finally:
+            loop.close()
+
+
+class TestGenerationStreamCancel:
+    def test_cancel_frees_pages_within_one_dispatch(self, tf_setup):
+        """ISSUE satellite: `GenerationStream.cancel()` retires the
+        slot and pool occupancy returns to the pre-submit level."""
+        from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
+
+        p, cfg = tf_setup
+        loop = DecodeLoop(p, cfg, slots=2, page_size=8, start=False)
+        try:
+            baseline = loop.snapshot()["pages_in_use"]
+            st = loop.submit([1, 2, 3, 4, 5, 6, 7, 8, 9], 40)
+            loop.tick()
+            assert loop.snapshot()["pages_in_use"] > baseline
+            assert st.cancel() is True
+            loop.tick()           # ONE scheduler dispatch later...
+            assert loop.snapshot()["pages_in_use"] == baseline
+            assert st.finish_reason == "cancelled"
+            assert st.cancel() is False  # idempotent once done
+            assert loop.snapshot()["cancelled"] == 1
+        finally:
+            loop.close()
+
+    def test_cancel_while_queued_never_admits(self, tf_setup):
+        from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
+
+        p, cfg = tf_setup
+        loop = DecodeLoop(p, cfg, slots=1, page_size=8, start=False)
+        try:
+            st = loop.submit([1, 2, 3], 4)
+            assert st.cancel() is True
+            loop.tick()
+            assert st.finish_reason == "cancelled"
+            snap = loop.snapshot()
+            assert snap["dispatches"] == 0
+            assert snap["prefill_programs"] == 0
+        finally:
+            loop.close()
+
+    def test_cancel_with_live_scheduler_returns_partial_tokens(
+            self, tf_setup):
+        from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
+
+        p, cfg = tf_setup
+        with DecodeLoop(p, cfg, slots=2, page_size=8) as loop:
+            st = loop.submit([1, 2, 3], 40)
+            it = st.tokens(timeout=60)
+            got = [next(it) for _ in range(2)]  # it is mid-flight
+            st.cancel()
+            rest = list(it)       # drains cleanly, no error raised
+            assert st.finish_reason == "cancelled"
+            assert st.result(timeout=10) == got + rest
+            # pool occupancy returned to the pre-submit level
+            deadline = time.monotonic() + 5
+            while (loop.snapshot()["pages_in_use"] > 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert loop.snapshot()["pages_in_use"] == 0
+
+
+# ============================================= HTTP surface: 504s, resets
+class TestServerDeadlinesHTTP:
+    def test_expired_deadline_is_504_machine_readable_no_compute(self):
+        """ISSUE acceptance: an already-expired deadline is rejected at
+        the server WITHOUT reaching a compiled step — the batcher batch
+        counter and engine program cache don't move."""
+        net = _net()
+        with serve_network(net, n_replicas=1, max_delay_ms=1.0,
+                           warmup_shape=(4,)) as handle:
+            before = json.loads(urllib.request.urlopen(
+                f"{handle.url}/stats", timeout=30).read())
+            x = [[0.1, 0.2, 0.3, 0.4]]
+            # header-borne budget of 0: expired on arrival
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"{handle.url}/predict", {"inputs": x},
+                      headers={"X-Deadline-Ms": "0"})
+            assert ei.value.code == 504
+            body = json.loads(ei.value.read())
+            assert body["error"] == "deadline_exceeded"
+            assert body["deadline_ms"] == 0
+            # body-borne budget is honoured too
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"{handle.url}/predict",
+                      {"inputs": x, "deadline_ms": 0})
+            assert ei.value.code == 504
+            after = json.loads(urllib.request.urlopen(
+                f"{handle.url}/stats", timeout=30).read())
+            assert (after["batcher"]["batches"]
+                    == before["batcher"]["batches"])
+            assert (after["batcher"]["deadline_exceeded"] >= 2)
+            # a generous budget still serves normally
+            out = _post(f"{handle.url}/predict", {"inputs": x},
+                        headers={"X-Deadline-Ms": "60000"})
+            assert len(out["classes"]) == 1
+
+    def test_generate_expired_deadline_is_504(self, tf_setup):
+        from deeplearning4j_tpu.serving import InferenceEngine
+
+        p, cfg = tf_setup
+        gen = InferenceEngine.for_transformer(p, cfg)
+        with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           generate_engine=gen, slots=2,
+                           page_size=8) as handle:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"{handle.url}/generate",
+                      {"prompt": [1, 2, 3], "max_tokens": 4,
+                       "deadline_ms": 0})
+            assert ei.value.code == 504
+            assert json.loads(ei.value.read())["error"] \
+                == "deadline_exceeded"
+            # the decode loop saw the shed at its own admission gate
+            stats = json.loads(urllib.request.urlopen(
+                f"{handle.url}/stats", timeout=30).read())
+            dec = stats["generate"]["decode"]
+            assert dec["deadline_exceeded"] >= 1
+            assert dec["dispatches"] == 0
+
+    def test_midstream_deadline_expiry_is_machine_readable_in_band(
+            self, tf_setup):
+        """A budget that dies MID-STREAM (the decode loop's reap) keeps
+        the deadline_exceeded wire shape — in-band, since the 200 and
+        headers are long gone."""
+        from deeplearning4j_tpu.serving import InferenceEngine
+
+        p, cfg = tf_setup
+        gen = InferenceEngine.for_transformer(p, cfg)
+        with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           generate_engine=gen, slots=2,
+                           page_size=8) as handle:
+            req = urllib.request.Request(
+                f"{handle.url}/generate",
+                data=json.dumps({"prompt": [1, 2, 3], "max_tokens": 61,
+                                 "stream": True,
+                                 "deadline_ms": 150}).encode(),
+                headers={"Content-Type": "application/json"})
+            events = []
+            with urllib.request.urlopen(req, timeout=60) as r:
+                while True:
+                    line = r.readline()
+                    if not line:
+                        break
+                    events.append(json.loads(line))
+            # 61 tokens of interpret-mode decode far outlast 150ms: the
+            # reap retires the slot and the error line carries the
+            # machine shape (not a stringified exception)
+            errs = [e for e in events if "error" in e]
+            assert errs and errs[-1]["error"] == "deadline_exceeded"
+            assert "deadline_ms" in errs[-1]
+            # and the slot's pages came back
+            assert self._await_pages_baseline(handle.url, 0)
+
+    def _pages_in_use(self, url):
+        text = urllib.request.urlopen(f"{url}/metrics",
+                                      timeout=30).read().decode()
+        vals = [float(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+                if ln.startswith("dl4j_kv_pages_in_use")]
+        assert vals, "dl4j_kv_pages_in_use not exported"
+        return sum(vals)
+
+    def _await_pages_baseline(self, url, baseline, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._pages_in_use(url) <= baseline:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def test_midstream_reset_fault_frees_slot(self, tf_setup):
+        """ISSUE satellite: a mid-stream socket reset on /generate —
+        the client's connection dies abruptly, the slot is cancelled
+        and `dl4j_kv_pages_in_use` returns to baseline."""
+        from deeplearning4j_tpu.serving import InferenceEngine
+
+        p, cfg = tf_setup
+        gen = InferenceEngine.for_transformer(p, cfg)
+        with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           generate_engine=gen, slots=2,
+                           page_size=8) as handle:
+            baseline = self._pages_in_use(handle.url)
+            chaos.configure([Rule("generate.midstream", "reset",
+                                  at=[2])])
+            req = urllib.request.Request(
+                f"{handle.url}/generate",
+                data=json.dumps({"prompt": [1, 2, 3], "max_tokens": 60,
+                                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(Exception) as ei:
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    while r.readline():
+                        pass
+            # an RST surfaces as ConnectionReset / IncompleteRead /
+            # a protocol error depending on where the read was
+            assert not isinstance(ei.value, AssertionError)
+            chaos.deactivate()
+            assert self._await_pages_baseline(handle.url, baseline)
+            assert chaos.hit("generate.midstream") is None  # plan gone
+
+    def test_midstream_error_fault_reports_in_band(self, tf_setup):
+        """A non-reset mid-stream failure is reported IN-BAND (headers
+        are gone) and still cancels the request's slots."""
+        from deeplearning4j_tpu.serving import InferenceEngine
+
+        p, cfg = tf_setup
+        gen = InferenceEngine.for_transformer(p, cfg)
+        with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           generate_engine=gen, slots=2,
+                           page_size=8) as handle:
+            baseline = self._pages_in_use(handle.url)
+            chaos.configure([Rule("generate.midstream", "error", at=[1],
+                                  message="injected midstream")])
+            req = urllib.request.Request(
+                f"{handle.url}/generate",
+                data=json.dumps({"prompt": [1, 2, 3], "max_tokens": 60,
+                                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            events = []
+            with urllib.request.urlopen(req, timeout=60) as r:
+                while True:
+                    line = r.readline()
+                    if not line:
+                        break
+                    events.append(json.loads(line))
+            chaos.deactivate()
+            assert any("error" in e and "injected midstream"
+                       in e["error"] for e in events)
+            assert self._await_pages_baseline(handle.url, baseline)
+
+    def test_client_disconnect_midstream_frees_pages(self, tf_setup):
+        """ISSUE acceptance: a client that hangs up mid-/generate has
+        its slot cancelled and its KV pages freed — within one
+        scheduler dispatch, observed as `dl4j_kv_pages_in_use`
+        returning to baseline."""
+        from deeplearning4j_tpu.serving import InferenceEngine
+
+        p, cfg = tf_setup
+        gen = InferenceEngine.for_transformer(p, cfg)
+        with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           generate_engine=gen, slots=2,
+                           page_size=8) as handle:
+            baseline = self._pages_in_use(handle.url)
+            disc = telemetry.get_registry().counter(
+                "dl4j_serve_client_disconnects",
+                "streaming clients that hung up mid-/generate (their "
+                "slots were cancelled and their KV pages freed)")
+            before = disc.value
+            body = json.dumps({"prompt": [1, 2, 3], "max_tokens": 50,
+                               "stream": True}).encode()
+            s = socket.create_connection(
+                ("127.0.0.1", handle.port), timeout=30)
+            s.sendall((f"POST /generate HTTP/1.1\r\n"
+                       f"Host: 127.0.0.1:{handle.port}\r\n"
+                       "Content-Type: application/json\r\n"
+                       f"Content-Length: {len(body)}\r\n"
+                       "\r\n").encode() + body)
+            # read until at least one token chunk arrived (the slot is
+            # live and holding pages), then vanish without a FIN dance
+            got = b""
+            while b'"token"' not in got:
+                got += s.recv(4096)
+            assert self._pages_in_use(handle.url) > baseline
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                         __import__("struct").pack("ii", 1, 0))
+            s.close()  # RST: the server's next chunk write fails
+            assert self._await_pages_baseline(handle.url, baseline)
+            assert disc.value == before + 1
+
+    def test_accept_hang_fault_times_out_client(self):
+        """`server.accept` hang: the replica accepts and never answers
+        — exactly the failure the router's per-hop deadline-derived
+        timeouts defend against."""
+        net = _net()
+        with serve_network(net, n_replicas=1, max_delay_ms=1.0,
+                           warmup_shape=(4,)) as handle:
+            chaos.configure([Rule("server.accept", "hang", at=[0],
+                                  hang_s=5.0)])
+            t0 = time.perf_counter()
+            with pytest.raises(Exception):
+                _post(f"{handle.url}/predict",
+                      {"inputs": [[0.1, 0.2, 0.3, 0.4]]}, timeout=0.5)
+            assert time.perf_counter() - t0 < 4.0  # client timed out
+            chaos.deactivate()
+            # the server itself recovers for the next request
+            out = _post(f"{handle.url}/predict",
+                        {"inputs": [[0.1, 0.2, 0.3, 0.4]]})
+            assert len(out["classes"]) == 1
+
+
+# ====================================================== checkpoint faults
+class TestCheckpointIOFaults:
+    def _payload(self):
+        return {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                "cursor": 7}
+
+    def test_shard_write_fault_never_surfaces_partial(self, tmp_path):
+        """The `between_files` crash drill, driven through the chaos
+        registry: an injected shard-write error leaves the previous
+        committed step as the only visible checkpoint."""
+        from deeplearning4j_tpu.checkpoint.format import (latest_step,
+                                                          write_checkpoint)
+
+        root = str(tmp_path)
+        write_checkpoint(root, 1, self._payload())
+        chaos.configure([Rule("checkpoint.write", "error", at=[0],
+                              message="disk died")])
+        with pytest.raises(ChaosError, match="disk died"):
+            write_checkpoint(root, 2, self._payload())
+        chaos.deactivate()
+        assert latest_step(root) == 1
+
+    def test_rename_fault_before_marker_is_invisible(self, tmp_path):
+        from deeplearning4j_tpu.checkpoint.format import (MARKER,
+                                                          latest_step,
+                                                          load_tree,
+                                                          write_checkpoint)
+
+        root = str(tmp_path)
+        write_checkpoint(root, 1, self._payload())
+        # ordinal 1 of checkpoint.rename within one save is the MARKER
+        # publish (0 is the manifest) — fire exactly there
+        chaos.configure([Rule("checkpoint.rename", "error", at=[1],
+                              message="power cut")])
+        with pytest.raises(ChaosError, match="power cut"):
+            write_checkpoint(root, 2, self._payload())
+        chaos.deactivate()
+        assert latest_step(root) == 1
+        back, manifest = load_tree(root)
+        assert manifest["step"] == 1 and back["cursor"] == 7
+        assert MARKER  # imported on purpose: the contract under test
+
+    def test_seeded_write_faults_are_deterministic(self, tmp_path):
+        """Same seed -> the same save attempts fail, run to run."""
+        from deeplearning4j_tpu.checkpoint.format import (list_steps,
+                                                          write_checkpoint)
+
+        def run(sub):
+            root = str(tmp_path / sub)
+            chaos.configure([Rule("checkpoint.write", "error",
+                                  prob=0.4)], seed=3)
+            ok = []
+            for step in range(8):
+                try:
+                    write_checkpoint(root, step, self._payload())
+                    ok.append(step)
+                except ChaosError:
+                    pass
+            chaos.deactivate()
+            assert list_steps(root) == ok
+            return ok
+
+        a, b = run("a"), run("b")
+        assert a == b and 0 < len(a) < 8
+
+
+# ================================================== numeric faults (NaN)
+class TestTrainBatchNaNFault:
+    def test_nan_poisoned_batch_feeds_the_guardian(self):
+        """An injected `train.batch` NaN fault produces exactly the
+        non-finite step the guardian's on-device defense skips: params
+        stay untouched and a skip event fires — the crash-free
+        numeric-fault drill (docs/FAULT_TOLERANCE.md)."""
+        from deeplearning4j_tpu.optimize.guardian import GuardianPolicy
+        from deeplearning4j_tpu.optimize.listeners import \
+            CollectGuardianEvents
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(24, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 24)]
+        net = _net()
+        net.fit(x, y)  # establish updater state, chaos-free
+        before = np.asarray(net.params())
+        ev = CollectGuardianEvents()
+        chaos.configure([Rule("train.batch", "nan", at=[0])])
+        net.fit(x, y, guardian=GuardianPolicy(check_every=1,
+                                              listeners=[ev]))
+        chaos.deactivate()
+        assert "skip" in ev.kinds()
+        np.testing.assert_array_equal(before, np.asarray(net.params()))
+        # and the next (clean) step moves params again
+        net.fit(x, y)
+        assert not np.array_equal(before, np.asarray(net.params()))
+
+
+# ======================================== hung-replica defense (breaker)
+class TestCircuitBreaker:
+    def test_threshold_trips_open(self):
+        b = CircuitBreaker(threshold=3, reset_s=60.0)
+        assert not b.record_timeout()
+        assert not b.record_timeout()
+        assert b.record_timeout()      # the third trips it
+        assert b.state == CircuitBreaker.OPEN
+        assert b.opens == 1
+
+    def test_success_resets_the_count(self):
+        b = CircuitBreaker(threshold=2, reset_s=60.0)
+        b.record_timeout()
+        b.record_success()
+        assert not b.record_timeout()  # the streak restarted
+        assert b.state == CircuitBreaker.CLOSED
+
+    def test_half_open_after_reset_then_close_or_reopen(self):
+        b = CircuitBreaker(threshold=1, reset_s=0.05)
+        assert b.record_timeout()
+        assert b.state == CircuitBreaker.OPEN
+        assert not b.allow_probe()     # too early
+        time.sleep(0.06)
+        assert b.allow_probe()         # transitions to half_open
+        assert b.state == CircuitBreaker.HALF_OPEN
+        b.reopen()                     # probe failed
+        assert b.state == CircuitBreaker.OPEN
+        time.sleep(0.06)
+        assert b.allow_probe()
+        b.record_success()             # probe passed
+        assert b.state == CircuitBreaker.CLOSED
+
+    def test_half_open_timeout_retrips_immediately(self):
+        b = CircuitBreaker(threshold=3, reset_s=0.05)
+        for _ in range(3):
+            b.record_timeout()
+        time.sleep(0.06)
+        assert b.allow_probe()
+        assert b.record_timeout()      # ONE failure in half_open trips
+        assert b.opens == 2
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="threshold"):
+            CircuitBreaker(threshold=0)
+
+
+class _HangableReplica:
+    """Fake replica endpoint: /healthz + /readyz always answer (the
+    heartbeat path sees a perfectly healthy member) while /predict can
+    be switched into accept-then-hang — the hung-but-TCP-alive failure
+    mode only the circuit breaker can evict."""
+
+    def __init__(self):
+        self.hang = threading.Event()
+        self.served = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body: bytes):
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/healthz"):
+                    self._send(200, b'{"ok": true}')
+                elif self.path.startswith("/readyz"):
+                    self._send(200, b'{"ready": true}')
+                else:
+                    self._send(404, b"{}")
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                if outer.hang.is_set():
+                    time.sleep(30)  # accepted, never answers in time
+                    return
+                outer.served.append(time.monotonic())
+                self._send(200, b'{"outputs": [[1.0]], "classes": [0]}')
+
+        self.handle = start_http_server(Handler)
+        self.url = self.handle.url
+
+    def close(self):
+        self.handle.close()
+
+
+class TestHungReplicaDefense:
+    def test_timeout_marks_suspect_retry_succeeds_on_peer(self):
+        hung, healthy = _HangableReplica(), _HangableReplica()
+        hung.hang.set()
+        fleet = Fleet(start=False, heartbeat_timeout=30.0,
+                      request_timeout=0.3, retry_budget=2,
+                      breaker_threshold=3)
+        try:
+            rep_hung = fleet.attach(hung.url, replica_id="hung")
+            fleet.attach(healthy.url, replica_id="ok")
+            fleet.poll()
+            assert fleet.ready_count() == 2
+            body = json.dumps({"inputs": [[0.0]]}).encode()
+            # route until the hung replica is tried: its timeout marks
+            # it SUSPECT and the retry lands on the healthy peer — the
+            # CLIENT never sees a failure
+            for _ in range(3):
+                status, _, _ = fleet.forward_predict(body)
+                assert status == 200
+                if rep_hung.state == SUSPECT:
+                    break
+            assert rep_hung.state == SUSPECT
+            snap = fleet.snapshot()
+            assert snap["request_timeouts"] >= 1
+            assert snap["retries"] >= 1
+            assert snap["states"][SUSPECT] == 1
+        finally:
+            fleet.close()
+            hung.close()
+            healthy.close()
+
+    def test_breaker_opens_evicts_then_half_open_readmits(self):
+        """Deterministic tier-1 twin of the SIGSTOP drill: suspect ->
+        breaker-open -> evict -> (recovery) -> half-open /readyz probe
+        -> readmit. Every client request succeeds throughout."""
+        hung, healthy = _HangableReplica(), _HangableReplica()
+        hung.hang.set()
+        fleet = Fleet(start=False, heartbeat_timeout=30.0,
+                      request_timeout=0.25, retry_budget=2,
+                      breaker_threshold=2, breaker_reset_s=0.1)
+        try:
+            rep_hung = fleet.attach(hung.url, replica_id="hung")
+            fleet.attach(healthy.url, replica_id="ok")
+            fleet.poll()
+            body = json.dumps({"inputs": [[0.0]]}).encode()
+            # drive traffic until the breaker trips: suspicion decays
+            # after a quiet breaker_reset_s, the replica re-enters the
+            # rotation, and its next timeout advances the CONSECUTIVE
+            # streak to the threshold — which EVICTS it
+            for _ in range(12):
+                status, _, _ = fleet.forward_predict(body)
+                assert status == 200  # zero client-visible failures
+                if rep_hung.state == EVICTED:
+                    break
+                time.sleep(0.12)  # > breaker_reset_s: suspicion decays
+            assert rep_hung.state == EVICTED
+            assert "circuit breaker" in rep_hung.eviction_reason
+            assert rep_hung.breaker.state == CircuitBreaker.OPEN
+            snap = fleet.snapshot()
+            assert snap["breaker_opens"] == 1
+            assert snap["breakers"]["open"] == 1
+
+            # while OPEN (reset_s not yet elapsed on a fresh timeout),
+            # a poll does NOT readmit even though /readyz answers 200
+            rep_hung.breaker.opened_at = time.monotonic()
+            fleet.poll()
+            assert rep_hung.state == EVICTED
+
+            # recovery: the replica unhangs; after reset_s the breaker
+            # half-opens, the /readyz probe passes, and it is READMITTED
+            hung.hang.clear()
+            time.sleep(0.12)
+            fleet.poll()
+            assert rep_hung.state == READY
+            assert rep_hung.breaker.state == CircuitBreaker.CLOSED
+            assert fleet.snapshot()["readmissions"] == 1
+            # and it serves real traffic again
+            for _ in range(4):
+                status, _, _ = fleet.forward_predict(body)
+                assert status == 200
+            assert len(hung.served) > 0
+        finally:
+            fleet.close()
+            hung.close()
+            healthy.close()
+
+    def test_success_clears_suspect(self):
+        flaky = _HangableReplica()
+        fleet = Fleet(start=False, heartbeat_timeout=30.0,
+                      request_timeout=0.25, retry_budget=0,
+                      breaker_threshold=5)
+        try:
+            rep = fleet.attach(flaky.url)
+            fleet.poll()
+            body = json.dumps({"inputs": [[0.0]]}).encode()
+            flaky.hang.set()
+            with pytest.raises(Exception):
+                fleet.forward_predict(body)
+            assert rep.state == SUSPECT
+            flaky.hang.clear()
+            status, _, _ = fleet.forward_predict(body)
+            assert status == 200
+            assert rep.state == READY  # the request just progressed
+            assert rep.breaker.consecutive_timeouts == 0
+        finally:
+            fleet.close()
+            flaky.close()
+
+    def test_router_deadline_shed_before_any_replica(self):
+        """ISSUE acceptance (router admission point): an expired budget
+        is shed at the router — no replica sees the request."""
+        replica = _HangableReplica()
+        fleet = Fleet(start=False, heartbeat_timeout=30.0)
+        try:
+            fleet.attach(replica.url)
+            fleet.poll()
+            body = json.dumps({"inputs": [[0.0]]}).encode()
+            with pytest.raises(DeadlineExceededError):
+                fleet.forward_predict(body,
+                                      deadline=Deadline.from_ms(0))
+            assert replica.served == []
+            assert fleet.snapshot()["deadline_exceeded"]["predict"] >= 1
+        finally:
+            fleet.close()
+            replica.close()
+
+
+class TestSpawnerOrphanCleanup:
+    def test_atexit_sweep_kills_the_whole_process_group(self, tmp_path):
+        """ISSUE satellite: a router that dies without close() must not
+        leak live replica servers holding ports. The unit-level pin:
+        the atexit sweep SIGKILLs a registered process's whole
+        session/group — INCLUDING grandchildren that outlive an
+        already-reaped leader (the group survives its leader, so the
+        sweep must target pgid == leader pid, never os.getpgid)."""
+        import signal as _signal
+        import subprocess
+        import sys
+
+        from deeplearning4j_tpu.serving import fleet as fleet_mod
+
+        # a stand-in "replica": its own session leader (as spawn()
+        # creates them) with a grandchild that records its pid
+        pidfile = tmp_path / "grandchild.pid"
+        tmpfile = tmp_path / "grandchild.pid.tmp"
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "import os, subprocess, sys, time;"
+             "p = subprocess.Popen([sys.executable, '-c',"
+             "'import time; time.sleep(600)']);"
+             f"f = open({str(tmpfile)!r}, 'w');"
+             "f.write(str(p.pid)); f.close();"
+             # rename AFTER the close: the parent never reads a
+             # partially-written pid
+             f"os.rename({str(tmpfile)!r}, {str(pidfile)!r});"
+             "time.sleep(600)"],
+            start_new_session=True)
+        fleet_mod._register_spawned(proc)
+        try:
+            deadline = time.monotonic() + 30
+            while not pidfile.exists() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            gpid = int(pidfile.read_text())
+            # the hard case: the leader dies AND is reaped, the
+            # grandchild keeps the group (and would keep its ports)
+            proc.kill()
+            proc.wait(timeout=10)
+            fleet_mod._kill_spawned_orphans()  # what atexit runs
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    os.kill(gpid, 0)
+                except ProcessLookupError:
+                    break  # grandchild swept with the group
+                time.sleep(0.05)
+            else:
+                raise AssertionError("grandchild survived the sweep")
+            # registry is drained: a second sweep has nothing to do
+            assert proc not in fleet_mod._SPAWNED_PROCS
+        finally:
+            try:  # pragma: no cover — cleanup on failure
+                os.killpg(proc.pid, _signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                pass
+
+    def test_stop_unregisters_from_the_orphan_registry(self):
+        import subprocess
+        import sys
+
+        from deeplearning4j_tpu.serving import fleet as fleet_mod
+        from deeplearning4j_tpu.serving.fleet import ReplicaSpawner
+
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(600)"],
+            start_new_session=True)
+        fleet_mod._register_spawned(proc)
+        ReplicaSpawner.stop(proc, timeout=10)
+        assert proc.poll() is not None
+        assert proc not in fleet_mod._SPAWNED_PROCS
+
+
+# ================================= real processes: SIGSTOP drill + soak
+def _spawner(tmp_path, net, extra_env=None):
+    from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
+    from deeplearning4j_tpu.serving.fleet import ReplicaSpawner
+
+    ckpt = str(tmp_path / "chaos.ckpt")
+    DefaultModelSaver(ckpt, keep_old=False).save(net)
+    env = dict(os.environ,
+               PYTHONPATH=REPO_ROOT + os.pathsep
+               + os.environ.get("PYTHONPATH", ""),
+               JAX_PLATFORMS="cpu")
+    if extra_env:
+        env.update(extra_env)
+    return ReplicaSpawner(ckpt, serve_args=["--max-delay-ms", "1"],
+                          env=env)
+
+
+@pytest.mark.slow
+class TestSigstopDrill:
+    def test_sigstop_suspect_breaker_evict_sigcont_readmit(
+            self, tmp_path):
+        """ISSUE flagship drill on REAL replica processes: SIGSTOP one
+        replica mid-hammer (hung-but-TCP-alive — the kernel keeps
+        accepting into its listen backlog), assert zero client failures
+        within deadline budgets, breaker-open eviction, and SIGCONT ->
+        half-open `/readyz` readmission."""
+        from deeplearning4j_tpu.serving.router import serve_fleet
+
+        net = _net()
+        fleet = Fleet(spawner=_spawner(tmp_path, net),
+                      heartbeat_interval=0.2, heartbeat_timeout=3.0,
+                      request_timeout=0.5, retry_budget=2,
+                      breaker_threshold=2, breaker_reset_s=0.4)
+        router = None
+        try:
+            fleet.spawn(2)
+            fleet.wait_ready(2, timeout=150)
+            router = serve_fleet(fleet)
+            victim = next(iter(fleet._replicas.values()))
+
+            x = np.random.RandomState(0).rand(2, 4)
+            failures, stop = [], threading.Event()
+
+            def hammer():
+                while not stop.is_set():
+                    try:
+                        out = _post(f"{router.url}/predict",
+                                    {"inputs": x.tolist()}, timeout=30,
+                                    headers={"X-Deadline-Ms": "20000"})
+                        if len(out["classes"]) != 2:
+                            failures.append("bad shape")
+                    except Exception as e:  # noqa: BLE001
+                        failures.append(repr(e))
+
+            threads = [threading.Thread(target=hammer, daemon=True)
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.5)
+            chaos.sigstop(victim.proc)   # hung, NOT dead
+            stopped_at = time.monotonic()
+            while victim.state != EVICTED:
+                if time.monotonic() - stopped_at > 15.0:
+                    raise AssertionError(
+                        f"breaker never evicted: {fleet.snapshot()}")
+                time.sleep(0.02)
+            assert "circuit breaker" in victim.eviction_reason
+            time.sleep(0.5)              # hammer the survivor a while
+            chaos.sigcont(victim.proc)   # recovery half of the drill
+            readmit_by = time.monotonic() + 15.0
+            while victim.state != READY:
+                if time.monotonic() > readmit_by:
+                    raise AssertionError(
+                        f"never readmitted: {fleet.snapshot()}")
+                time.sleep(0.05)
+            time.sleep(0.3)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert failures == []        # ZERO failures, throughout
+            snap = fleet.snapshot()
+            assert snap["breaker_opens"] >= 1
+            assert snap["readmissions"] >= 1
+            assert snap["request_timeouts"] >= fleet.breaker_threshold
+        finally:
+            if router is not None:
+                router.close(stop_replicas=True)
+            else:
+                fleet.close(stop_replicas=True)
+
+
+@pytest.mark.slow
+class TestRandomizedChaosSoak:
+    def test_seeded_soak_over_serving_stack(self, tf_setup):
+        """Randomized (but seed-deterministic) soak: a probabilistic
+        mix of socket faults plays against a live serving endpoint
+        under concurrent /predict + /generate load. The invariants: the
+        server answers every post-fault request, no KV pages leak, and
+        the failure log is replayable (`plan.replay_rules()`)."""
+        from deeplearning4j_tpu.serving import InferenceEngine
+
+        seed = int(os.environ.get("DL4J_TPU_CHAOS_SOAK_SEED", "1234"))
+        p, cfg = tf_setup
+        gen = InferenceEngine.for_transformer(p, cfg)
+        with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           generate_engine=gen, slots=4, page_size=8,
+                           warmup_shape=(4,)) as handle:
+            plan = chaos.configure(
+                [Rule("server.read", "delay", prob=0.15, delay_s=0.02),
+                 Rule("server.predict", "error", prob=0.1),
+                 Rule("generate.midstream", "reset", prob=0.05),
+                 Rule("generate.midstream", "error", prob=0.05)],
+                seed=seed)
+            x = [[0.1, 0.2, 0.3, 0.4]]
+            outcomes = {"ok": 0, "faulted": 0}
+            lock = threading.Lock()
+
+            def client(i):
+                rng = np.random.RandomState(seed + i)
+                for _ in range(15):
+                    try:
+                        if rng.rand() < 0.5:
+                            _post(f"{handle.url}/predict",
+                                  {"inputs": x}, timeout=30)
+                        else:
+                            _post(f"{handle.url}/generate",
+                                  {"prompt": [1, 2, 3],
+                                   "max_tokens": 3}, timeout=60)
+                        k = "ok"
+                    except Exception:  # noqa: BLE001 — injected
+                        k = "faulted"
+                    with lock:
+                        outcomes[k] += 1
+
+            threads = [threading.Thread(target=client, args=(i,),
+                                        daemon=True) for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            log = plan.log()
+            chaos.deactivate()
+            assert outcomes["ok"] > 0          # the stack survived
+            assert plan.fired() == len(log) > 0
+            # failure-log replayability: the recorded schedule converts
+            # to exact-ordinal rules (the CI repro path)
+            replay = chaos.ChaosPlan(plan.replay_rules())
+            assert sum(len(r.at) for r in replay.rules) == len(log)
+            # chaos off: the endpoint is fully healthy again
+            for _ in range(5):
+                out = _post(f"{handle.url}/predict", {"inputs": x},
+                            timeout=30)
+                assert len(out["classes"]) == 1
+            # no KV pages leaked by the injected mid-stream failures
+            text = urllib.request.urlopen(
+                f"{handle.url}/metrics", timeout=30).read().decode()
+            pages = [float(ln.rsplit(" ", 1)[1])
+                     for ln in text.splitlines()
+                     if ln.startswith("dl4j_kv_pages_in_use")]
+            assert pages and sum(pages) == 0

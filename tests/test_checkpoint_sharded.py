@@ -273,8 +273,15 @@ class TestAsyncWriter:
         root = str(tmp_path)
         w = AsyncCheckpointWriter(root, max_in_flight=1)
         gate = threading.Event()
-        w.between_files = lambda fname: gate.wait(timeout=30)
+        entered = threading.Event()  # worker is INSIDE step 1's write
+
+        def gated(fname):
+            entered.set()
+            gate.wait(timeout=30)
+
+        w.between_files = gated
         w.save(_payload(), step=1)
+        assert entered.wait(timeout=30)  # step 1 is out of the queue
 
         second_returned = threading.Event()
 
@@ -284,7 +291,13 @@ class TestAsyncWriter:
 
         t = threading.Thread(target=second, daemon=True)
         t.start()
-        # the queue admits step 2 (1 slot), a THIRD save must block
+        # step 2 must OCCUPY the single queue slot before the third
+        # save starts — started any earlier, saves 2 and 3 race for
+        # the slot and whichever wins "returns" (the old flake). With
+        # the worker gated inside step 1, second() returning IS step 2
+        # sitting in the queue.
+        assert second_returned.wait(timeout=30)
+        # now a THIRD save must block on the bounded queue
         third_returned = threading.Event()
 
         def third():
