@@ -982,6 +982,198 @@ def bench_chaos():
         shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_train_elastic():
+    """Self-healing elastic training drills (ISSUE 9,
+    docs/FAULT_TOLERANCE.md "Supervisor runbook"). Three drills over a
+    TrainingSupervisor with 2 out-of-process workers:
+
+    (a) **kill drill** — SIGKILL one worker mid-run; the supervisor
+        evicts (process exit is observed directly), respawns, the wave
+        re-forms, and the completed run's params must be BIT-IDENTICAL
+        to an uninterrupted run at the same wave schedule (canonical
+        job-seq fold order + exact wave membership). Recovery time
+        (kill -> replacement RUNNING) is the primary metric.
+    (b) **capacity-loss drill** — SIGKILL with respawn budget 0; the
+        supervisor flushes and restarts the wave from the last
+        COMMITTED sharded checkpoint resharded 2 -> 1 workers, with
+        ZERO lost or double-trained examples (the folded batch-index
+        trace must tile the stream exactly once).
+    (c) **SIGSTOP drill** — a stopped worker still holds TCP, so
+        liveness never lapses (heartbeat_timeout is set far beyond the
+        run); only the steps-per-heartbeat progress watermark may evict
+        it, within its configured window.
+    """
+    import tempfile
+    import threading
+
+    from deeplearning4j_tpu.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.datasets.iris import load_iris
+    from deeplearning4j_tpu.scaleout.api import CollectionJobIterator
+    from deeplearning4j_tpu.scaleout.registry import ConfigRegistry
+    from deeplearning4j_tpu.scaleout.supervisor import (TrainingSupervisor,
+                                                        WorkerSpawner)
+    from deeplearning4j_tpu.testing import chaos as chaos_mod
+
+    conf_json = (NeuralNetConfiguration.builder()
+                 .lr(0.1).n_in(4).activation_function("tanh")
+                 .optimization_algo("iteration_gradient_descent")
+                 .num_iterations(2).use_adagrad(False).momentum(0.0)
+                 .list(2).hidden_layer_sizes([8])
+                 .override(1, layer="output", loss_function="mcxent",
+                           activation_function="softmax", n_out=3)
+                 .pretrain(False).build().to_json())
+    x, y = load_iris()
+    x, y = np.asarray(x), np.asarray(y)
+    rng = np.random.RandomState(0)
+    batches = [(x[i], y[i])
+               for i in (rng.choice(len(x), 24, replace=False)
+                         for _ in range(6))]
+    work = tempfile.mkdtemp(prefix="dl4j_bench_elastic_")
+
+    def supervisor(tag, **kw):
+        registry_root = os.path.join(work, f"reg_{tag}")
+        jobs = [DataSet(bx, by) for bx, by in batches]
+        kw.setdefault("heartbeat_timeout", 2.0)
+        kw.setdefault("progress_timeout", 90.0)
+        return TrainingSupervisor(
+            CollectionJobIterator(jobs), run_name=tag,
+            registry=ConfigRegistry(registry_root),
+            performer_class=("deeplearning4j_tpu.scaleout.perform."
+                            "NeuralNetWorkPerformer"),
+            performer_conf={"conf_json": conf_json, "epochs": 1},
+            n_workers=2, conf_json=conf_json,
+            spawner=WorkerSpawner(registry_root, tag), **kw)
+
+    n_jobs = len(batches)
+    exact = list(range(n_jobs))
+
+    # -------- uninterrupted reference (same wave schedule)
+    ref = supervisor("ref").run(timeout=240.0)
+
+    # -------- (a) kill drill: SIGKILL -> respawn -> bit-identical
+    sup_a = supervisor("kill", checkpoint_dir=os.path.join(work, "ck_a"),
+                       max_respawns=2, respawn_backoff_s=0.05)
+    drill_a = {}
+
+    def killer():
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            for rec in list(sup_a.members.values()):
+                if (rec.performed >= 1 and rec.proc is not None
+                        and rec.generation == 0):
+                    chaos_mod.sigkill(rec.proc)
+                    t_kill = time.monotonic()
+                    drill_a["killed"] = rec.id
+                    while time.monotonic() - t_kill < 120:
+                        if any(r.generation > 0 and r.state == "running"
+                               for r in list(sup_a.members.values())):
+                            drill_a["recovery_s"] = round(
+                                time.monotonic() - t_kill, 3)
+                            return
+                        time.sleep(0.005)
+                    return
+            time.sleep(0.005)
+
+    kt = threading.Thread(target=killer, daemon=True)
+    kt.start()
+    final_a = sup_a.run(timeout=240.0)
+    kt.join(timeout=10)
+    bit_identical = bool(final_a is not None
+                         and np.array_equal(ref, final_a))
+    trace_a_exact = sorted(sup_a.folded_seqs) == exact
+
+    # -------- (b) capacity loss: no respawn budget -> resharded resume
+    sup_b = supervisor("caploss",
+                       checkpoint_dir=os.path.join(work, "ck_b"),
+                       max_respawns=0)
+    drill_b = {}
+
+    def killer_b():
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if sup_b.waves >= 1:
+                for rec in list(sup_b.members.values()):
+                    if rec.performed >= 1 and rec.proc is not None:
+                        chaos_mod.sigkill(rec.proc)
+                        drill_b["killed"] = rec.id
+                        return
+            time.sleep(0.005)
+
+    kbt = threading.Thread(target=killer_b, daemon=True)
+    kbt.start()
+    final_b = sup_b.run(timeout=240.0)
+    kbt.join(timeout=10)
+    resume = (sup_b.resume_events[-1] if sup_b.resume_events else {})
+    trace_b_exact = sorted(sup_b.folded_seqs) == exact
+    resharded = bool(resume.get("resharded")
+                     and resume.get("survivors") == 1)
+
+    # -------- (c) SIGSTOP: watermark detection within its window
+    progress_timeout = 2.0
+    sup_c = supervisor("sigstop", max_respawns=1,
+                       respawn_backoff_s=0.05,
+                       heartbeat_timeout=600.0,  # liveness CANNOT evict
+                       progress_timeout=progress_timeout)
+    drill_c = {}
+
+    def stopper():
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            for rec in list(sup_c.members.values()):
+                if (rec.performed >= 1 and rec.proc is not None
+                        and rec.generation == 0):
+                    chaos_mod.sigstop(rec.proc)
+                    drill_c["stopped"] = rec.id
+                    drill_c["t"] = time.monotonic()
+                    return
+            time.sleep(0.005)
+
+    st = threading.Thread(target=stopper, daemon=True)
+    st.start()
+    final_c = sup_c.run(timeout=240.0)
+    st.join(timeout=10)
+    detect_s = None
+    if drill_c.get("stopped"):
+        rec = sup_c.members[drill_c["stopped"]]
+        if rec.evicted_at is not None:
+            detect_s = round(rec.evicted_at - drill_c["t"], 3)
+        drill_c["reason"] = rec.eviction_reason
+    # detection bound: the job must first be dispatched to the stopped
+    # member (one wave) and then sit a full watermark window; allow one
+    # extra window of monitor slack
+    detect_bound = 3 * progress_timeout + 5.0
+    sigstop_ok = bool(
+        detect_s is not None and detect_s <= detect_bound
+        and (drill_c.get("reason") or "").startswith("hung")
+        and final_c is not None
+        and sorted(sup_c.folded_seqs) == exact)
+
+    return {
+        "value": drill_a.get("recovery_s"),
+        "unit": "s_kill_to_respawned_running",
+        "lower_is_better": True,
+        "workers": 2, "jobs": n_jobs,
+        "kill_drill": {**drill_a, "bit_identical": bit_identical,
+                       "trace_exact": trace_a_exact,
+                       "respawns": sup_a.respawns_used},
+        "capacity_loss_drill": {**drill_b, "resume": resume,
+                                "trace_exact": trace_b_exact},
+        "sigstop_drill": {**drill_c, "detect_s": detect_s,
+                          "bound_s": detect_bound},
+        "gate_bit_identical_after_respawn": bit_identical,
+        "gate_no_lost_or_double_trained": bool(trace_a_exact
+                                               and trace_b_exact),
+        "gate_resharded_resume": resharded,
+        "gate_recovery_bounded": bool(
+            drill_a.get("recovery_s") is not None
+            and drill_a["recovery_s"] <= 60.0
+            and resume.get("recovery_s") is not None
+            and resume["recovery_s"] <= 60.0),
+        "gate_sigstop_watermark": sigstop_ok,
+    }
+
+
 def bench_checkpoint():
     """Checkpoint subsystem config (docs/CHECKPOINTS.md): (a) the
     per-autosave STEP-LOOP STALL — blocking single-file npz writer
@@ -1233,6 +1425,7 @@ CONFIGS = {
     "serve": bench_serve,
     "fleet": bench_fleet,
     "chaos": bench_chaos,
+    "train_elastic": bench_train_elastic,
     "checkpoint": bench_checkpoint,
     "telemetry": bench_telemetry,
     "lenet": bench_lenet,
@@ -1250,6 +1443,7 @@ METRIC_NAMES = {
     "serve": "serving_decode_tokens_per_sec_cached",
     "fleet": "fleet_predict_rows_per_sec_4_replicas",
     "chaos": "chaos_sigstop_breaker_eviction_s",
+    "train_elastic": "train_elastic_kill_recovery_s",
     "checkpoint": "checkpoint_async_save_stall_ms",
     "telemetry": "telemetry_instrumented_step_time_ms",
     "lenet": "lenet_mnist_step_time_ms",

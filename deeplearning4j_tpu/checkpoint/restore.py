@@ -26,8 +26,8 @@ from typing import Any, Optional, Tuple
 
 from deeplearning4j_tpu.checkpoint import format as ckfmt
 
-__all__ = ["resolve_root", "load_payload_tree", "restore_network",
-           "restore_params_for", "validate_like"]
+__all__ = ["resolve_root", "discover_latest", "load_payload_tree",
+           "restore_network", "restore_params_for", "validate_like"]
 
 
 def resolve_root(path: str) -> Tuple[str, Optional[int]]:
@@ -40,6 +40,32 @@ def resolve_root(path: str) -> Tuple[str, Optional[int]]:
                 f"{path} holds a manifest but is not named step_<n>")
         return os.path.dirname(os.path.abspath(path)), step
     return path, None
+
+
+def discover_latest(root: str) -> Tuple[str, int]:
+    """`--resume auto`: locate the newest COMMITTED step under a
+    checkpoint root (or accept a single step dir) without the caller
+    naming the step. Raises CheckpointError naming the candidate torn
+    step dirs when the root holds only uncommitted saves — the operator
+    must know the difference between "nothing to resume" and "saves
+    exist but none ever committed"."""
+    root, pinned = resolve_root(root)
+    if pinned is not None:
+        return root, pinned
+    steps = ckfmt.list_steps(root)
+    if steps:
+        return root, steps[-1]
+    torn = ckfmt.list_steps(root, committed_only=False)
+    if torn:
+        raise ckfmt.CheckpointError(
+            f"no COMMITTED checkpoint under {root!r}; found "
+            f"{len(torn)} uncommitted (torn) step dir(s): "
+            f"{[ckfmt.step_dir_name(s) for s in torn]} — these saves "
+            "never reached their commit marker (crashed mid-write) and "
+            "cannot be restored; delete them or point --resume at an "
+            "older root")
+    raise ckfmt.CheckpointError(
+        f"no sharded checkpoint steps under {root!r}")
 
 
 def load_payload_tree(path: str, step: Optional[int] = None

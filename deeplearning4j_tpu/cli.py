@@ -118,33 +118,55 @@ class _Telemetry:
 def cmd_train(args) -> int:
     from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
 
+    if args.elastic:
+        return _cmd_train_elastic(args)
     tele = _Telemetry(args)
     if tele.metrics is not None:
         # announce BEFORE the fit: the auto-assigned port is useless if
         # it first appears after the endpoint is already shut down
         print(json.dumps(tele.announce()), flush=True)
     try:
-        net = _load_model(args.model)
+        if args.checkpoint_every is not None and not args.checkpoint_dir:
+            # refusing beats a run the user believes is checkpointed
+            print("--checkpoint-every needs --checkpoint-dir DIR "
+                  "(where the autosaves go)", file=sys.stderr)
+            return 2
+        resume_info = None
+        if args.resume:
+            net, resume_info = _resume_network(args)
+            if net is None:
+                return 2
+        else:
+            net = _load_model(args.model)
         x, y = _load_csv(args.input, args.label_columns, _model_n_out(net))
         if y is None:
             print("train requires labels (--label-columns >= 1)",
                   file=sys.stderr)
             return 2
         saver = None
-        if args.checkpoint_every is not None and not args.checkpoint_dir:
-            # refusing beats a run the user believes is checkpointed
-            print("--checkpoint-every needs --checkpoint-dir DIR "
-                  "(where the autosaves go)", file=sys.stderr)
-            return 2
         if args.checkpoint_dir:
             # sharded async autosaves off the hot path (docs/CHECKPOINTS.md)
             from deeplearning4j_tpu.checkpoint import ShardedModelSaver
 
             saver = ShardedModelSaver(args.checkpoint_dir)
         try:
-            net.fit(x, y, epochs=args.epochs, saver=saver,
-                    checkpoint_every=(args.checkpoint_every or 1
-                                      if saver is not None else None))
+            every = (args.checkpoint_every or 1
+                     if saver is not None else None)
+            if resume_info is not None:
+                _fit_resumed(net, x, y, args, saver, resume_info)
+            elif args.batch_size:
+                # iterator path: the checkpoint cursor counts these
+                # mini-batches, which is what --resume fast-forwards to
+                from deeplearning4j_tpu.datasets import ListDataSetIterator
+                from deeplearning4j_tpu.datasets.api import DataSet
+
+                net.fit(ListDataSetIterator(DataSet(x, y),
+                                            args.batch_size),
+                        epochs=args.epochs, saver=saver,
+                        checkpoint_every=every)
+            else:
+                net.fit(x, y, epochs=args.epochs, saver=saver,
+                        checkpoint_every=every)
         finally:
             if saver is not None:
                 saver.close()  # every pending autosave is durable
@@ -156,8 +178,151 @@ def cmd_train(args) -> int:
         closed = tele.close()
     # announce() is NOT repeated here: the metrics endpoint is already
     # closed, and a dead URL in the summary line would mislead parsers
-    print(json.dumps({"saved": args.output, "score": score, **closed}))
+    summary = {"saved": args.output, "score": score, **closed}
+    if resume_info is not None:
+        summary["resumed_from"] = resume_info["step"]
+    print(json.dumps(summary))
     return 0
+
+
+def _resume_network(args):
+    """`--resume auto` (or an explicit path): restore params + updater
+    state + cursor from the newest COMMITTED step — no step dir named.
+    `auto` on an EMPTY checkpoint dir starts fresh (the restart-wrapper
+    semantic, matching the elastic supervisor); a dir holding only torn
+    saves still errors, listing the candidate step dirs. Returns
+    (net, info), (net, None) for a fresh `auto` start, or (None, None)
+    after printing the error."""
+    from deeplearning4j_tpu.checkpoint.format import CheckpointError
+    from deeplearning4j_tpu.checkpoint.restore import (discover_latest,
+                                                       restore_network)
+
+    source = args.checkpoint_dir if args.resume == "auto" else args.resume
+    if not source:
+        print("--resume auto needs --checkpoint-dir DIR to discover "
+              "the latest committed step from", file=sys.stderr)
+        return None, None
+    try:
+        root, step = discover_latest(source)
+        net, info = restore_network(root, step)
+    except (CheckpointError, FileNotFoundError) as e:
+        if args.resume == "auto" and "no sharded checkpoint steps" \
+                in str(e):
+            # nothing saved yet: auto means "resume IF any" — a restart
+            # wrapper's first launch starts fresh
+            print(json.dumps({"resuming": None,
+                              "note": "no committed checkpoint yet; "
+                                      "starting fresh"}), flush=True)
+            return _load_model(args.model), None
+        print(f"cannot resume: {e}", file=sys.stderr)
+        return None, None
+    print(json.dumps({"resuming": root, "step": step,
+                      "iterator_position": info.get("iterator_position"),
+                      "epoch": info.get("metadata", {}).get("epoch")}),
+          flush=True)
+    return net, info
+
+
+def _fit_resumed(net, x, y, args, saver, info) -> None:
+    """Continue a restored run: fast-forward the data stream to the
+    checkpoint's within-epoch cursor and seed the guard's position so
+    new autosaves extend — never collide with — the committed steps."""
+    from deeplearning4j_tpu.datasets import ListDataSetIterator
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.datasets.device_feed import DeviceFeed
+
+    position = int(info.get("iterator_position") or 0)
+    meta = info.get("metadata", {}) or {}
+    epoch = int(meta.get("epoch") or 0)
+    epoch_batch = int(meta.get("epoch_batch") or 0)
+    bs = args.batch_size or len(x)
+    feed = DeviceFeed(ListDataSetIterator(DataSet(x, y), bs))
+    feed.fast_forward(epoch_batch)
+    remaining = max(1, args.epochs - epoch)
+    net.fit(feed, epochs=remaining, saver=saver,
+            checkpoint_every=(args.checkpoint_every or 1
+                              if saver is not None else None),
+            start_position=position, start_epoch=epoch,
+            start_epoch_batch=epoch_batch)
+
+
+def _cmd_train_elastic(args) -> int:
+    """`train --elastic N`: the self-healing out-of-process path — a
+    TrainingSupervisor over N spawned workers with failure detection,
+    bounded respawn, straggler defense, and checkpoint-backed elastic
+    resume (docs/FAULT_TOLERANCE.md)."""
+    import tempfile
+
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.scaleout.api import CollectionJobIterator
+    from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
+    from deeplearning4j_tpu.scaleout.registry import ConfigRegistry
+    from deeplearning4j_tpu.scaleout.supervisor import (TrainingSupervisor,
+                                                        WorkerSpawner)
+
+    if args.resume == "auto" and not args.checkpoint_dir:
+        # same refusal as the non-elastic path: silently starting a
+        # fresh run would discard progress the user asked to keep
+        print("--resume auto needs --checkpoint-dir DIR to discover "
+              "the latest committed step from", file=sys.stderr)
+        return 2
+    tele = _Telemetry(args)
+    if tele.metrics is not None:
+        # announce BEFORE the run (cmd_train's contract): an
+        # auto-assigned metrics port is useless once the run is over
+        print(json.dumps(tele.announce()), flush=True)
+    try:
+        net = _load_model(args.model)
+        conf_json = net.to_json()
+        x, y = _load_csv(args.input, args.label_columns, _model_n_out(net))
+        if y is None:
+            print("train requires labels (--label-columns >= 1)",
+                  file=sys.stderr)
+            return 2
+        bs = args.batch_size or getattr(net.conf, "batch_size", None) or 32
+        batches = [DataSet(x[i:i + bs], y[i:i + bs])
+                   for i in range(0, len(x), bs)]
+        jobs = [b for _ in range(args.epochs) for b in batches]
+        work = (args.checkpoint_dir
+                or tempfile.mkdtemp(prefix="dl4j_elastic_"))
+        registry_root = os.path.join(work, "_registry")
+        run_name = f"cli-elastic-{os.getpid()}"
+        sup = TrainingSupervisor(
+            CollectionJobIterator(jobs), run_name=run_name,
+            registry=ConfigRegistry(registry_root),
+            performer_class=("deeplearning4j_tpu.scaleout.perform."
+                             "NeuralNetWorkPerformer"),
+            performer_conf={"conf_json": conf_json, "epochs": 1},
+            n_workers=args.elastic, conf_json=conf_json,
+            spawner=WorkerSpawner(registry_root, run_name),
+            checkpoint_dir=args.checkpoint_dir,
+            resume=args.resume,
+            max_respawns=args.max_respawns,
+            straggler_factor=args.straggler_factor,
+            status_port=args.status_port)
+        if sup.status_server is not None:
+            print(json.dumps({"status": sup.status_server.address,
+                              "workers": args.elastic}), flush=True)
+        final = sup.run(timeout=args.run_timeout)
+        trained = MultiLayerNetwork.from_config_json(
+            conf_json, params=np.asarray(final))
+        DefaultModelSaver(args.output).save(trained)
+        score = float(trained.score(x, y))
+        print(json.dumps({
+            "saved": args.output, "score": score,
+            "workers": args.elastic, "waves": sup.waves,
+            "jobs": len(jobs), "folded": len(sup.folded_seqs),
+            "respawns": sup.respawns_used,
+            "evictions": {k: int(c.value)
+                          for k, c in sup._m_evictions.items()
+                          if c.value},
+            "resumes": len(sup.resume_events),
+            **tele.close()}))
+        return 0
+    except BaseException:
+        tele.close()
+        raise
 
 
 def cmd_test(args) -> int:
@@ -390,6 +555,11 @@ def build_parser() -> argparse.ArgumentParser:
     p_train = sub.add_parser("train", help="fit a model and checkpoint it")
     common(p_train, True)
     p_train.add_argument("--epochs", type=int, default=1)
+    p_train.add_argument("--batch-size", type=int, default=None,
+                         help="mini-batch size (train through the "
+                              "device-feed iterator path; required for "
+                              "a mid-epoch --resume to line its cursor "
+                              "up, and the elastic job split unit)")
     p_train.add_argument("--checkpoint-dir", default=None, metavar="DIR",
                          help="write sharded async autosaves here during "
                               "the fit (docs/CHECKPOINTS.md); restorable "
@@ -399,6 +569,34 @@ def build_parser() -> argparse.ArgumentParser:
                          help="autosave cadence in fit ticks (requires "
                               "--checkpoint-dir; default 1 when the dir "
                               "is set)")
+    p_train.add_argument("--resume", default=None, metavar="auto|PATH",
+                         help="resume from a sharded checkpoint: 'auto' "
+                              "discovers the latest COMMITTED step under "
+                              "--checkpoint-dir (no step dir named); a "
+                              "path pins a root or step dir. Restores "
+                              "params + updater state + cursor "
+                              "(docs/FAULT_TOLERANCE.md)")
+    p_train.add_argument("--elastic", type=int, default=None, metavar="N",
+                         help="self-healing elastic training across N "
+                              "out-of-process workers (supervisor with "
+                              "failure detection, bounded respawn, "
+                              "straggler defense, elastic resume — "
+                              "docs/FAULT_TOLERANCE.md)")
+    p_train.add_argument("--max-respawns", type=int, default=3,
+                         help="total replacement workers the elastic "
+                              "supervisor may spawn before declaring "
+                              "capacity durably lost (then: resharded "
+                              "resume on the survivors)")
+    p_train.add_argument("--straggler-factor", type=float, default=4.0,
+                         help="evict-and-respawn a worker persistently "
+                              "slower than the wave median by this "
+                              "factor")
+    p_train.add_argument("--status-port", type=int, default=None,
+                         help="elastic: serve the supervisor's "
+                              "status/healthz/metrics endpoint on this "
+                              "port (0 = auto-assign)")
+    p_train.add_argument("--run-timeout", type=float, default=3600.0,
+                         help="elastic: overall run deadline in seconds")
     telemetry_flags(p_train)
     p_train.set_defaults(fn=cmd_train)
 

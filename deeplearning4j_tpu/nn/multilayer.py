@@ -265,7 +265,8 @@ class MultiLayerNetwork:
     def fit(self, x, labels=None, epochs: int = 1,
             device_feed: Optional[bool] = None,
             guardian=None, checkpoint_every: Optional[int] = None,
-            saver=None) -> None:
+            saver=None, start_position: int = 0,
+            start_epoch: int = 0, start_epoch_batch: int = 0) -> None:
         """Train. Accepts (x, labels) arrays or a DataSetIterator
         (reference fit(DataSet) :1172 / fit(DataSetIterator) :1021).
         Pretraining (if configured) runs ONCE over the data, then the
@@ -291,8 +292,21 @@ class MultiLayerNetwork:
         arms a SIGTERM hook that flushes a final checkpoint and raises
         `TrainingPreempted`. With everything off (the default) this is
         the historical code path, bit for bit. Guardian requires the
-        iteration_gradient_descent backprop algorithm."""
-        guard = make_guard(self, guardian, checkpoint_every, saver)
+        iteration_gradient_descent backprop algorithm.
+
+        Resuming a checkpointed run: `start_position`/`start_epoch`/
+        `start_epoch_batch` seed the guard's cursors with the restored
+        checkpoint's `iterator_position` and `metadata` epoch fields,
+        so subsequent autosaves continue the step numbering (no
+        collision with committed step dirs) and record a truthful
+        within-epoch cursor (a SECOND resume fast-forwards correctly) —
+        pair with `DeviceFeed.fast_forward(epoch_batch)` to position
+        the data stream (docs/FAULT_TOLERANCE.md, `cli train
+        --resume`)."""
+        guard = make_guard(self, guardian, checkpoint_every, saver,
+                           start_position=start_position,
+                           start_epoch=start_epoch,
+                           start_epoch_batch=start_epoch_batch)
         if guard is None:
             return self._fit_impl(x, labels, epochs, device_feed, None)
         with guard:

@@ -402,7 +402,9 @@ class TrainingGuard:
 
     def __init__(self, network, policy: Optional[GuardianPolicy] = None,
                  checkpoint_every: Optional[int] = None, saver=None,
-                 save_fn: Optional[Callable] = None):
+                 save_fn: Optional[Callable] = None,
+                 start_position: int = 0, start_epoch: int = 0,
+                 start_epoch_batch: int = 0):
         if checkpoint_every is not None and checkpoint_every < 1:
             raise ValueError(
                 f"checkpoint_every must be >= 1, got {checkpoint_every}")
@@ -416,9 +418,18 @@ class TrainingGuard:
         self.saver = saver
         self._save_fn = save_fn
         self.session = policy.session(self._emit) if policy else None
-        self.position = 0  # TOTAL batches consumed — the checkpoint cursor
-        self.epoch = 0  # current epoch (0-based; fit loops call begin_epoch)
-        self.epoch_position = 0  # batches consumed within the current epoch
+        #: TOTAL batches consumed — the checkpoint cursor. A resumed fit
+        #: seeds it (and the epoch) from the restored checkpoint so new
+        #: autosaves continue the step numbering.
+        self.position = int(start_position)
+        self.epoch = int(start_epoch)  # 0-based; fit loops call begin_epoch
+        #: batches consumed within the current epoch. Seeded on a
+        #: mid-epoch resume (the feed was fast-forwarded past
+        #: `start_epoch_batch` batches) so the NEXT checkpoint's
+        #: epoch_batch stays truthful — a second resume must not
+        #: fast-forward short and double-train.
+        self.epoch_position = int(start_epoch_batch)
+        self._epochs_begun = 0
         self._preempt = threading.Event()
         self._prev_handlers: dict = {}
 
@@ -488,9 +499,12 @@ class TrainingGuard:
         the total batches consumed (the flat-stream resume index the
         drills use), while metadata epoch/epoch_batch position a
         re-iterable source mid-epoch (`DeviceFeed.fast_forward`)."""
-        if self.position:
-            self.epoch += 1
-        self.epoch_position = 0
+        if self._epochs_begun:  # NOT `if self.position`: a resumed fit
+            self.epoch += 1     # starts mid-epoch with a nonzero cursor
+            self.epoch_position = 0
+        # first begin_epoch keeps a seeded start_epoch_batch: the
+        # resumed fit's first (partial) epoch is already mid-stream
+        self._epochs_begun += 1
 
     def tick(self) -> None:
         """Call once per consumed batch (fit_scan: per epoch), AFTER the
@@ -538,14 +552,20 @@ class TrainingGuard:
 
 
 def make_guard(network, guardian=None, checkpoint_every: Optional[int] = None,
-               saver=None, save_fn: Optional[Callable] = None
+               saver=None, save_fn: Optional[Callable] = None,
+               start_position: int = 0, start_epoch: int = 0,
+               start_epoch_batch: int = 0
                ) -> Optional[TrainingGuard]:
     """Build the per-fit TrainingGuard, or None when every guardian
     feature is off — callers keep the historical code path bit-for-bit.
 
     `guardian` is a GuardianPolicy, or True for defaults. A `saver`
-    without `checkpoint_every` arms the preemption flush only."""
+    without `checkpoint_every` arms the preemption flush only.
+    `start_position`/`start_epoch` seed the cursor for a resumed fit."""
     if guardian is None and not checkpoint_every and saver is None:
         return None
     policy = GuardianPolicy() if guardian is True else guardian
-    return TrainingGuard(network, policy, checkpoint_every, saver, save_fn)
+    return TrainingGuard(network, policy, checkpoint_every, saver, save_fn,
+                         start_position=start_position,
+                         start_epoch=start_epoch,
+                         start_epoch_batch=start_epoch_batch)

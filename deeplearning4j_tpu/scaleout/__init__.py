@@ -46,6 +46,11 @@ from deeplearning4j_tpu.scaleout.checkpoint import (  # noqa: F401
 )
 from deeplearning4j_tpu.scaleout.checkpoint import UriModelSaver  # noqa: F401
 from deeplearning4j_tpu.scaleout.registry import ConfigRegistry  # noqa: F401
+from deeplearning4j_tpu.scaleout.supervisor import (  # noqa: F401
+    SupervisorAbort,
+    TrainingSupervisor,
+    WorkerSpawner,
+)
 from deeplearning4j_tpu.scaleout.storage import (  # noqa: F401
     ArtifactStore,
     StorageDataSetIterator,

@@ -28,15 +28,20 @@ import numpy as np
 
 @dataclass
 class Job:
-    """Unit of distributable work (reference Job.java:24)."""
+    """Unit of distributable work (reference Job.java:24). `seq` is the
+    job's position in the run's job stream (assigned at dispatch) — the
+    stable identity that survives eviction/re-serve, so aggregation can
+    fold updates in a canonical order and resume audits can account for
+    every batch exactly once."""
 
     work: Any
     worker_id: str
     result: Any = None
     retries: int = 0
+    seq: Optional[int] = None
 
     def __repr__(self):
-        return (f"Job(worker_id={self.worker_id!r}, "
+        return (f"Job(worker_id={self.worker_id!r}, seq={self.seq}, "
                 f"has_result={self.result is not None})")
 
 

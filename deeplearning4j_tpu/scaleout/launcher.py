@@ -72,6 +72,7 @@ class MultiProcessMaster(DistributedRuntime):
                  conf_json: Optional[str] = None,
                  work_dir: Optional[str] = None,
                  status_port: Optional[int] = None,
+                 status_extra=None, status_health=None,
                  **kw):
         if work_dir is not None:
             from deeplearning4j_tpu.scaleout.api import LocalWorkRetriever
@@ -91,7 +92,8 @@ class MultiProcessMaster(DistributedRuntime):
             from deeplearning4j_tpu.scaleout.status import StatusServer
             self.status_server = StatusServer(
                 self.tracker, runtime=self, host=host,
-                port=status_port).start()
+                port=status_port, extra=status_extra,
+                health=status_health).start()
         run_conf = {
             TRACKER_ADDRESS: self.server.address,
             PERFORMER_CLASS: performer_class,

@@ -52,11 +52,14 @@ ALLOWED_METHODS = frozenset({
 def _to_wire(obj: Any) -> Any:
     """Jobs (and DataSet-bearing work) -> codec-friendly dicts."""
     if isinstance(obj, Job):
-        return {"__job__": True,
+        wire = {"__job__": True,
                 "work": _to_wire(obj.work),
                 "result": _to_wire(obj.result),
                 "worker_id": obj.worker_id,
                 "retries": obj.retries}
+        if obj.seq is not None:  # omit-when-absent keeps old frames valid
+            wire["seq"] = int(obj.seq)
+        return wire
     if hasattr(obj, "features") and hasattr(obj, "labels"):  # DataSet
         return {"__dataset__": True,
                 "features": np.asarray(obj.features),
@@ -71,10 +74,12 @@ def _to_wire(obj: Any) -> Any:
 def _from_wire(obj: Any) -> Any:
     if isinstance(obj, dict):
         if obj.get("__job__"):
+            seq = obj.get("seq")
             return Job(work=_from_wire(obj["work"]),
                        worker_id=obj["worker_id"],
                        result=_from_wire(obj["result"]),
-                       retries=int(obj["retries"]))
+                       retries=int(obj["retries"]),
+                       seq=None if seq is None else int(seq))
         if obj.get("__dataset__"):
             from deeplearning4j_tpu.datasets.api import DataSet
             return DataSet(obj["features"], obj["labels"])

@@ -47,10 +47,59 @@ log = logging.getLogger(__name__)
 JOBS_DROPPED = "_jobs_dropped"
 
 
+#: bounded job-retry budget shared by every worker variant
+MAX_JOB_RETRIES = 3
+
+
+def perform_job(tracker, worker_id, performer, job, *,
+                work_retriever=None, max_retries=MAX_JOB_RETRIES,
+                before_perform=None) -> bool:
+    """Execute ONE fetched job under the worker contract shared by the
+    in-process `_Worker`, the launcher's remote worker, and the
+    supervised elastic worker: resolve the payload (WorkRetriever data
+    plane), perform, publish the update, clear the job — or requeue it
+    with the bounded retry budget, incrementing `JOBS_DROPPED` when the
+    budget runs out so the master's exact wave barrier stops waiting.
+    `before_perform(job)` runs inside the try (a failure there is a job
+    failure — the supervised worker's chaos point). ConnectionError
+    propagates: for a remote worker the master being gone is a shutdown
+    signal, not a job failure. Returns True when the job performed."""
+    try:
+        if before_perform is not None:
+            before_perform(job)
+        if job.work is None and work_retriever is not None:
+            # payload travels via the WorkRetriever data plane, not the
+            # tracker (reference WorkRetriever.load)
+            stored = work_retriever.load(worker_id)
+            if stored is not None:
+                job.work = stored.work
+        performer.perform(job)
+        tracker.add_update(worker_id, job.result)
+        tracker.clear_job(worker_id)
+        if work_retriever is not None:
+            work_retriever.clear(worker_id)
+        return True
+    except ConnectionError:
+        raise
+    except Exception:  # requeue (bounded), don't kill the loop
+        log.exception("worker %s failed job", worker_id)
+        tracker.clear_job(worker_id)
+        job.retries += 1
+        if job.retries < max_retries:
+            tracker.add_job(job)
+        else:
+            log.error("dropping job for %s after %d retries",
+                      worker_id, job.retries)
+            # the master's exact wave barrier must not wait for an
+            # update that will never come
+            tracker.increment(JOBS_DROPPED)
+        return False
+
+
 class _Worker(threading.Thread):
     """Worker loop (reference WorkerActor.java:166-215 heartbeat body)."""
 
-    MAX_RETRIES = 3
+    MAX_RETRIES = MAX_JOB_RETRIES
 
     def __init__(self, worker_id: str, tracker: InMemoryStateTracker,
                  performer: WorkerPerformer, interval: float,
@@ -81,31 +130,10 @@ class _Worker(threading.Thread):
                 tracker.done_replicating(wid)
             job = tracker.job_for(wid)
             if job is not None and job.result is None:
-                try:
-                    if job.work is None and self.work_retriever is not None:
-                        # payload travels via the WorkRetriever data plane,
-                        # not the tracker (reference WorkRetriever.load)
-                        stored = self.work_retriever.load(wid)
-                        if stored is not None:
-                            job.work = stored.work
-                    self.performer.perform(job)
-                    tracker.add_update(wid, job.result)
+                if perform_job(tracker, wid, self.performer, job,
+                               work_retriever=self.work_retriever,
+                               max_retries=self.MAX_RETRIES):
                     self.performed += 1
-                    tracker.clear_job(wid)
-                    if self.work_retriever is not None:
-                        self.work_retriever.clear(wid)
-                except Exception:  # requeue (bounded), don't kill the loop
-                    log.exception("worker %s failed job", wid)
-                    tracker.clear_job(wid)
-                    job.retries += 1
-                    if job.retries < self.MAX_RETRIES:
-                        tracker.add_job(job)
-                    else:
-                        log.error("dropping job for %s after %d retries",
-                                  wid, job.retries)
-                        # the master's exact wave barrier must not wait for
-                        # an update that will never come
-                        tracker.increment(JOBS_DROPPED)
             else:
                 time.sleep(self.interval)
 
@@ -159,6 +187,16 @@ class DistributedRuntime:
         #: updates folded into the published model (one per job); see
         #: _resume_cursor for how the checkpointed position is derived
         self.jobs_aggregated = 0
+        #: stream positions of every update folded into the published
+        #: model, in fold order — the batch-index trace the elastic
+        #: drills audit ("no example dropped or double-trained")
+        self.folded_seqs: List[int] = []
+        #: last job-stream seq dispatched to each worker: aggregation
+        #: folds in SEQ order so the averaged sum is a function of the
+        #: wave's job set alone, never of completion order or of which
+        #: (possibly respawned) worker computed which job — what makes
+        #: an elastic run bit-identical to an uninterrupted one
+        self._seq_of: Dict[str, int] = {}
         self._orphan_jobs: List[Job] = []  # evicted workers' in-flight jobs
         # Exact wave membership (reference IterativeReduceWorkRouter.java:46-57
         # barrier): number of jobs dispatched into the current wave. The wave
@@ -201,15 +239,20 @@ class DistributedRuntime:
                     job = self.job_iterator.next(wid)
                 except StopIteration:
                     break
+                if job.seq is None:
+                    job.seq = self.jobs_consumed
                 self.jobs_consumed += 1
             else:
                 break
+            if job.seq is not None:
+                self._seq_of[wid] = job.seq
             if self.work_retriever is not None and job.work is not None:
                 # data plane: payload goes through the WorkRetriever
                 # (reference BatchActor routeJob -> workRetriever.save);
                 # the tracker carries only the light descriptor
                 self.work_retriever.save(wid, job)
-                job = Job(work=None, worker_id=wid, retries=job.retries)
+                job = Job(work=None, worker_id=wid, retries=job.retries,
+                          seq=job.seq)
             self.router.route_job(job)
             sent += 1
         return sent
@@ -220,6 +263,7 @@ class DistributedRuntime:
     def _open_wave(self) -> int:
         """Dispatch a new wave and record its exact membership size."""
         self._wave_dropped_base = self.tracker.count(JOBS_DROPPED)
+        self._wave_opened_at = time.monotonic()
         self._wave_size = self._dispatch_wave()
         return self._wave_size
 
@@ -234,7 +278,8 @@ class DistributedRuntime:
             # not "whatever jobs happen to remain".
             if self._orphan_jobs:
                 sent = self._dispatch_wave(orphans_only=True)
-                if not sent and not n_outstanding:
+                if not sent and not n_outstanding \
+                        and not self._expecting_capacity():
                     # Every surviving member has reported and nobody is
                     # free to take the orphan (live workers all hold
                     # pending updates; re-dispatching to one would
@@ -261,6 +306,14 @@ class DistributedRuntime:
         elif not n_updates and not n_outstanding:
             if not self._has_work():
                 return True
+            if (self._expecting_capacity()
+                    and len(self._free_workers()) < self.n_workers):
+                # a replacement worker is on its way: hold the next
+                # wave until the pool is whole again, so wave
+                # composition matches the uninterrupted schedule
+                # (capacity that never arrives flips the flag off and
+                # the wave opens on the survivors)
+                return False
             self._open_wave()
         return False
 
@@ -280,10 +333,20 @@ class DistributedRuntime:
         """Average pending updates into the new global model (reference
         MasterActor DoneMessage handling :219-330). Only the snapshot of
         updates that was aggregated is cleared — updates arriving
-        mid-aggregation survive for the next round."""
+        mid-aggregation survive for the next round.
+
+        Updates fold in canonical JOB-SEQ order (not arrival order): a
+        float sum depends on operand order, so folding by the stream
+        position of the job each update answers makes the published
+        params a pure function of the wave's job set — an evicted
+        worker's orphan job redone by a respawned peer aggregates bit-
+        identically to the uninterrupted run."""
         snapshot = self.tracker.worker_updates()
         if not snapshot:
             return
+        inf = float("inf")
+        snapshot = sorted(snapshot,
+                          key=lambda w: (self._seq_of.get(w, inf), w))
         agg = self.aggregator_factory()
         for wid in snapshot:
             update = self.tracker.load_update(wid)
@@ -310,6 +373,9 @@ class DistributedRuntime:
         self.tracker.set_current(new)
         for wid in snapshot:
             self.tracker.clear_update(wid)
+            seq = self._seq_of.pop(wid, None)
+            if seq is not None:
+                self.folded_seqs.append(seq)
         self.waves += 1
         self.jobs_aggregated += len(snapshot)
         if (self.model_saver is not None and self.save_every_waves
@@ -344,6 +410,22 @@ class DistributedRuntime:
             iterator_position=self._resume_cursor(),
             metadata={"waves": self.waves})
 
+    def _tick(self):
+        """Per-poll supervision hook, called once per master loop pass
+        (including the registration wait). The base runtime does nothing;
+        TrainingSupervisor overrides it with process health, respawn,
+        straggler, and elastic-resume duties."""
+
+    def _expecting_capacity(self) -> bool:
+        """True while replacement workers are known to be on their way
+        (the supervisor's respawn pipeline). An open wave holding an
+        undeliverable orphan then KEEPS its barrier — the orphan is
+        served to the respawned member and the wave re-forms with its
+        original membership (what makes the respawn path bit-identical)
+        — instead of closing early on the survivors. The base runtime
+        has no respawn pipeline, so capacity never arrives: False."""
+        return False
+
     def _evict_stale(self):
         for wid in self.tracker.stale_workers():
             log.warning("evicting stale worker %s", wid)
@@ -363,7 +445,8 @@ class DistributedRuntime:
                 # poison the reassigned copy
                 self._orphan_jobs.append(Job(work=work,
                                              worker_id=orphan.worker_id,
-                                             retries=orphan.retries))
+                                             retries=orphan.retries,
+                                             seq=orphan.seq))
 
     # ---------------------------------------------------------------- train
     def run(self, timeout: float = 120.0) -> np.ndarray:
@@ -373,11 +456,13 @@ class DistributedRuntime:
         deadline = time.time() + timeout
         # wait for registration
         while len(self.tracker.workers()) < self.n_workers:
+            self._tick()  # a crashed spawn must be respawnable even here
             if time.time() > deadline:
                 raise TimeoutError("workers failed to register")
             time.sleep(self.interval)
 
         while time.time() < deadline:
+            self._tick()
             self._evict_stale()
             n_updates = len(self.tracker.worker_updates())
             n_outstanding = len(self.tracker.jobs())

@@ -145,10 +145,17 @@ class StatusServer:
 
     def __init__(self, tracker, runtime=None, host: str = "127.0.0.1",
                  port: int = 0,
-                 extra: Optional[Callable[[], Dict[str, Any]]] = None):
+                 extra: Optional[Callable[[], Dict[str, Any]]] = None,
+                 health: Optional[Callable[[], Dict[str, Any]]] = None):
         self.tracker = tracker
         self.runtime = runtime
         self.extra = extra
+        #: optional readiness verdict merged into /healthz: a dict whose
+        #: "ok" key decides the status code (False -> 503). The training
+        #: supervisor wires its quorum check here so a fleet scrape (or a
+        #: cluster manager) sees quorum loss as unhealthy, not merely as
+        #: a status.json detail.
+        self.health = health
         self.started_at = time.time()
         outer = self
 
@@ -174,17 +181,24 @@ class StatusServer:
                         if self.path.startswith("/healthz"):
                             from deeplearning4j_tpu import __version__
 
-                            body = json.dumps({
-                                "ok": True,
+                            verdict = (_jsonable(outer.health())
+                                       if outer.health is not None else {})
+                            payload = {
+                                "ok": bool(verdict.get("ok", True)),
                                 "uptime_s": round(
                                     time.time() - outer.started_at, 3),
                                 "version": __version__,
-                            }).encode()
+                            }
+                            payload.update(
+                                {k: v for k, v in verdict.items()
+                                 if k != "ok"})
+                            body = json.dumps(payload).encode()
                             ctype = "application/json"
+                            code = 200 if payload["ok"] else 503
                         else:
                             _, ctype, body = exposition.handle_metrics_get(
                                 self.path)
-                        code = 200
+                            code = 200
                     except Exception as e:
                         body = json.dumps({"error": repr(e)}).encode()
                         ctype = "application/json"

@@ -1,0 +1,205 @@
+"""Supervised training-worker entrypoint.
+
+The process half of `scaleout/supervisor.py`: joins a registered run
+(same ConfigRegistry/RemoteStateTracker bootstrap as
+`scaleout/launcher.py`), then runs the worker loop with the supervisor's
+two extra planes wired in:
+
+- a **progress socket** back to the supervisor (`progress_address` in
+  the run config): one long-lived TCP connection carrying NDJSON lines
+  — `{"worker_id"}` hello, then `{"performed", "job_s"}` after every
+  job plus periodic idle beats from a dedicated reporter thread. The
+  supervisor heartbeats the tracker on the worker's behalf while this
+  socket is OPEN (kernel-held counts: that is the point — a SIGSTOP'd
+  worker "heartbeats" until the progress watermark catches it); the
+  worker itself never calls `tracker.heartbeat`.
+- **chaos points** (`testing/chaos.py`, activated per process via
+  `DL4J_TPU_CHAOS` in the spawn env): `worker.spawn` before
+  registration, `worker.step` before each job's fit, and
+  `worker.heartbeat` before each progress line — so hang/delay/error
+  schedules are seeded and replayable per worker.
+
+Exit contract: clean exit when the master finishes (`is_done`) or its
+tracker connection drops (master gone == shutdown, the launcher's
+convention); non-zero on a `worker.spawn` chaos error or any bootstrap
+failure, which the supervisor turns into eviction + respawn/backoff.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import socket
+import threading
+import time
+
+from deeplearning4j_tpu.scaleout.launcher import (PERFORMER_CLASS,
+                                                  PERFORMER_CONF,
+                                                  TRACKER_ADDRESS,
+                                                  WORK_DIR,
+                                                  _resolve_performer)
+from deeplearning4j_tpu.scaleout.registry import ConfigRegistry
+from deeplearning4j_tpu.scaleout.rpc import RemoteStateTracker
+from deeplearning4j_tpu.scaleout.runtime import perform_job
+from deeplearning4j_tpu.testing import chaos
+
+log = logging.getLogger(__name__)
+
+
+class _ProgressReporter:
+    """Streams progress lines to the supervisor from its own thread —
+    so a hung train step (chaos `worker.step` hang, a wedged device)
+    keeps reporting idle beats while the performed-count stalls, which
+    is exactly the hung-but-heartbeating shape the supervisor's
+    watermark evicts."""
+
+    def __init__(self, address: str, worker_id: str,
+                 interval: float = 0.25):
+        host, port = address.rsplit(":", 1)
+        self.worker_id = worker_id
+        self.interval = float(interval)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=10.0)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        self.performed = 0
+        self.last_job_s = None  # float | None
+        self._dirty = threading.Event()
+        self._closed = threading.Event()
+        self._send({"worker_id": worker_id})  # hello names the peer
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"progress-{worker_id}")
+        self._thread.start()
+
+    def _send(self, payload: dict) -> None:
+        data = (json.dumps(payload) + "\n").encode()
+        with self._lock:
+            self._sock.sendall(data)
+
+    def _line(self) -> dict:
+        out = {"worker_id": self.worker_id, "performed": self.performed}
+        if self.last_job_s is not None:
+            out["job_s"] = self.last_job_s
+        return out
+
+    def _run(self) -> None:
+        while not self._closed.is_set():
+            self._dirty.wait(timeout=self.interval)
+            self._dirty.clear()
+            if self._closed.is_set():
+                return
+            try:
+                chaos.hit("worker.heartbeat")
+                self._send(self._line())
+            except chaos.ChaosError:
+                # injected reporter death: progress lines stop but the
+                # socket stays OPEN — the hung-but-heartbeating shape
+                return
+            except OSError:
+                # supervisor gone or connection severed: training
+                # continues; liveness is the supervisor's call now
+                return
+
+    def report_job(self, job_s: float) -> None:
+        self.performed += 1
+        self.last_job_s = float(job_s)
+        self._dirty.set()  # wake the reporter for an immediate line
+
+    def close(self) -> None:
+        self._closed.set()
+        self._dirty.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def run_supervised_worker(*, registry_root: str, run_name: str,
+                          worker_id: str,
+                          heartbeat_interval: float = 0.05,
+                          registration_timeout: float = 30.0) -> int:
+    """Join a supervised run and work until the master finishes.
+    Returns the number of jobs performed."""
+    chaos.hit("worker.spawn")  # error kind = spawn crash (respawn drill)
+    registry = ConfigRegistry(registry_root)
+    conf = registry.retrieve_run(run_name, timeout=registration_timeout)
+    tracker = RemoteStateTracker(conf[TRACKER_ADDRESS])
+    performer_cls = _resolve_performer(conf[PERFORMER_CLASS])
+    performer = performer_cls()
+    if conf.get(PERFORMER_CONF):
+        performer.setup(conf[PERFORMER_CONF])
+    retriever = None
+    if conf.get(WORK_DIR):
+        from deeplearning4j_tpu.scaleout.api import LocalWorkRetriever
+
+        retriever = LocalWorkRetriever(conf[WORK_DIR])
+    reporter = None
+    if conf.get("progress_address"):
+        reporter = _ProgressReporter(conf["progress_address"], worker_id)
+    performed = 0
+    log.info("worker %s joined supervised run %s", worker_id, run_name)
+    try:
+        if hasattr(performer, "bind_tracker"):
+            performer.bind_tracker(tracker)
+        tracker.add_worker(worker_id)
+        while not tracker.is_done():
+            if tracker.needs_replicate(worker_id):
+                current = tracker.get_current()
+                if current is not None:
+                    performer.update(current)
+                tracker.done_replicating(worker_id)
+            job = tracker.job_for(worker_id)
+            if job is None or job.result is not None:
+                time.sleep(heartbeat_interval)
+                continue
+            # the chaos point runs INSIDE the timed window (via
+            # before_perform): an injected delay models a slow step,
+            # and the straggler stats must see it as one. The
+            # execute/publish/bounded-retry contract is the ONE shared
+            # implementation (runtime.perform_job); a ConnectionError
+            # propagates to the master-gone clean exit below.
+            t0 = time.perf_counter()
+            if perform_job(tracker, worker_id, performer, job,
+                           work_retriever=retriever,
+                           before_perform=lambda j: chaos.hit(
+                               "worker.step", worker=worker_id,
+                               seq=j.seq)):
+                performed += 1
+                if reporter is not None:
+                    reporter.report_job(time.perf_counter() - t0)
+    except ConnectionError as e:
+        # master gone = shutdown signal (launcher.run_worker contract)
+        log.info("worker %s: master connection lost (%s), exiting",
+                 worker_id, e)
+    finally:
+        if reporter is not None:
+            reporter.close()
+        tracker.close()
+    return performed
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu.scaleout.worker",
+        description="Supervised elastic-training worker process "
+                    "(spawned by scaleout.supervisor.TrainingSupervisor)")
+    p.add_argument("--registry", required=True,
+                   help="ConfigRegistry root directory")
+    p.add_argument("--run", required=True, help="run name to join")
+    p.add_argument("--worker-id", required=True)
+    p.add_argument("--heartbeat-interval", type=float, default=0.05)
+    p.add_argument("--registration-timeout", type=float, default=30.0)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    performed = run_supervised_worker(
+        registry_root=args.registry, run_name=args.run,
+        worker_id=args.worker_id,
+        heartbeat_interval=args.heartbeat_interval,
+        registration_timeout=args.registration_timeout)
+    log.info("worker %s done: %d jobs", args.worker_id, performed)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

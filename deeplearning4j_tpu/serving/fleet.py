@@ -77,12 +77,10 @@ readmission/reload counters, per-route latency histograms,
 
 from __future__ import annotations
 
-import atexit
 import itertools
 import json
 import logging
 import os
-import signal
 import subprocess
 import sys
 import threading
@@ -93,6 +91,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.scaleout.statetracker import InMemoryStateTracker
+from deeplearning4j_tpu.utils import procs
 from deeplearning4j_tpu.serving.errors import (DEADLINE_HEADER, Deadline,
                                                OverloadedError)
 from deeplearning4j_tpu.serving.router import ReplicaClient
@@ -247,44 +246,14 @@ class FleetReplica:
 # spawned replica processes still alive, reaped at interpreter exit: a
 # router that dies without close() must not leak live replica servers
 # holding ports. Each replica runs in its OWN session/process group
-# (start_new_session), so the atexit sweep killpg's replicas (and any
-# grandchildren) without ever touching the router's group.
-_SPAWNED_PROCS: set = set()
-_spawn_lock = threading.Lock()
-_atexit_armed = False
-
-
-def _register_spawned(proc: subprocess.Popen) -> None:
-    global _atexit_armed
-    with _spawn_lock:
-        _SPAWNED_PROCS.add(proc)
-        if not _atexit_armed:
-            atexit.register(_kill_spawned_orphans)
-            _atexit_armed = True
-
-
-def _unregister_spawned(proc: subprocess.Popen) -> None:
-    with _spawn_lock:
-        _SPAWNED_PROCS.discard(proc)
-
-
-def _kill_spawned_orphans() -> None:
-    with _spawn_lock:
-        procs = list(_SPAWNED_PROCS)
-        _SPAWNED_PROCS.clear()
-    for proc in procs:
-        # each spawn is its own session leader, so pgid == proc.pid —
-        # never os.getpgid(), which fails once the leader is reaped
-        # even while grandchildren keep the group (and their ports)
-        # alive. killpg works as long as ANY group member lives.
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except (OSError, ProcessLookupError):
-            if proc.poll() is None:
-                try:
-                    proc.kill()
-                except OSError:
-                    pass
+# (start_new_session); the registry, atexit sweep, and group-kill
+# discipline are shared with the training supervisor's WorkerSpawner
+# (utils/procs.py holds the pid/pgid-recycling rationale). The module
+# aliases keep the historical names on fleet's surface.
+_SPAWNED_PROCS = procs.SPAWNED_PROCS
+_register_spawned = procs.register_spawned
+_unregister_spawned = procs.unregister_spawned
+_kill_spawned_orphans = procs.kill_spawned_orphans
 
 
 class ReplicaSpawner:
@@ -367,30 +336,11 @@ class ReplicaSpawner:
 
     @staticmethod
     def stop(proc: subprocess.Popen, timeout: float = 10.0) -> None:
-        """Terminate a spawned replica and its whole process group.
-
-        Ordering matters: the group SIGKILL sweep runs BEFORE the
-        leader is reaped — the un-reaped leader (alive or zombie) pins
-        pid == pgid, so the sweep can never hit a recycled pid. After
-        a reap, an emptied group's id is free for reuse and a blind
-        killpg could SIGKILL an unrelated process group."""
-        if proc.poll() is None:
-            # TERM the whole group (leader un-reaped: raceless), give
-            # it the graceful window, then KILL stragglers — still
-            # before any reap
-            try:
-                os.killpg(proc.pid, signal.SIGTERM)
-            except (OSError, ProcessLookupError):
-                proc.terminate()
-            try:
-                proc.wait(timeout=timeout)
-            except subprocess.TimeoutExpired:
-                try:
-                    os.killpg(proc.pid, signal.SIGKILL)
-                except (OSError, ProcessLookupError):
-                    proc.kill()
-                proc.wait(timeout=timeout)
-        _unregister_spawned(proc)
+        """Terminate a spawned replica and its whole process group —
+        TERM the group (leader un-reaped: raceless), give it the
+        graceful window, KILL stragglers. Ordering rationale lives in
+        utils/procs.stop_process_group."""
+        procs.stop_process_group(proc, timeout=timeout)
 
 
 class Autoscaler:

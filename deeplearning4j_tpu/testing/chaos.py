@@ -75,6 +75,16 @@ POINTS = {
                          "(manifest, COMMITTED marker)",
     "train.batch": "host training batch before H2D (NaN poison "
                    "feeding the guardian's non-finite defense)",
+    "worker.spawn": "supervised training worker entrypoint, before it "
+                    "registers (error = spawn crash, delay = slow boot "
+                    "— exercises the supervisor's respawn/backoff)",
+    "worker.step": "supervised training worker, before each job's fit "
+                   "(hang = hung-but-heartbeating worker for the "
+                   "progress watermark, delay = deterministic "
+                   "straggler, error = job failure/retry)",
+    "worker.heartbeat": "supervised training worker's progress "
+                        "reporter, before each progress line (hang/"
+                        "delay silence the telemetry plane)",
 }
 
 

@@ -1,0 +1,91 @@
+"""Spawned-process-group management shared by every subsystem that
+launches sibling processes (the serving fleet's `ReplicaSpawner`, the
+training supervisor's `WorkerSpawner`).
+
+Two pieces of pid/pgid-recycling-sensitive logic live here ONCE:
+
+- **Orphan sweep**: every spawn runs in its own session/process group
+  (`start_new_session=True`) and registers here; a single atexit hook
+  SIGKILLs whatever the owner never reaped, so a crash-exiting parent
+  cannot leak live children holding ports. The sweep uses
+  ``killpg(proc.pid)`` directly — never ``os.getpgid()``, which fails
+  once the leader is reaped even while grandchildren keep the group
+  (and their ports) alive; killpg works as long as ANY member lives.
+- **Group stop** (`stop_process_group`): the group sweep runs BEFORE
+  the leader is reaped — the un-reaped leader (alive or zombie) pins
+  pid == pgid, so the sweep can never hit a recycled pid. After a
+  reap, an emptied group's id is free for reuse and a blind killpg
+  could SIGKILL an unrelated process group — so an already-reaped
+  leader is only waited on, never group-swept.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import signal
+import subprocess
+import threading
+
+__all__ = ["register_spawned", "unregister_spawned",
+           "kill_spawned_orphans", "stop_process_group",
+           "SPAWNED_PROCS"]
+
+#: spawned session-leader processes still alive (shared registry)
+SPAWNED_PROCS: set = set()
+_lock = threading.Lock()
+_atexit_armed = False
+
+
+def register_spawned(proc: subprocess.Popen) -> None:
+    global _atexit_armed
+    with _lock:
+        SPAWNED_PROCS.add(proc)
+        if not _atexit_armed:
+            atexit.register(kill_spawned_orphans)
+            _atexit_armed = True
+
+
+def unregister_spawned(proc: subprocess.Popen) -> None:
+    with _lock:
+        SPAWNED_PROCS.discard(proc)
+
+
+def kill_spawned_orphans() -> None:
+    """SIGKILL every registered group (what atexit runs)."""
+    with _lock:
+        procs = list(SPAWNED_PROCS)
+        SPAWNED_PROCS.clear()
+    for proc in procs:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            if proc.poll() is None:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+
+
+def stop_process_group(proc: subprocess.Popen, timeout: float = 10.0,
+                       term_first: bool = True) -> None:
+    """Terminate a spawned process and its whole group, then reap and
+    unregister it. ``term_first=False`` goes straight to SIGKILL (for
+    hung/SIGSTOP'd members that will never honor SIGTERM)."""
+    if proc.poll() is None:
+        sig = signal.SIGTERM if term_first else signal.SIGKILL
+        try:
+            os.killpg(proc.pid, sig)
+        except (OSError, ProcessLookupError):
+            proc.send_signal(sig)
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                proc.kill()
+            proc.wait(timeout=timeout)
+    else:
+        proc.wait()  # reaped or zombie: collect; group id is NOT swept
+    unregister_spawned(proc)

@@ -50,6 +50,12 @@ def pytest_configure(config):
         "chaos); the fast deterministic subset runs in tier-1, the "
         "randomized soak and real-process SIGSTOP drills also carry "
         "@slow — run the whole layer with pytest -m chaos")
+    config.addinivalue_line(
+        "markers",
+        "elastic: self-healing elastic-training drills (scaleout."
+        "supervisor); fast seeded-chaos drills run in tier-1, the "
+        "SIGKILL/SIGSTOP process soaks also carry @slow — run the "
+        "whole layer with pytest -m elastic")
 
 
 def pytest_collection_modifyitems(config, items):

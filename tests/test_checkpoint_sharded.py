@@ -160,6 +160,57 @@ class TestFormat:
         assert removed == [1, 9]
         assert list_steps(root) == [2, 3]
 
+    def test_rotation_never_deletes_the_only_committed_step(
+            self, tmp_path):
+        """ISSUE 9 satellite (regression pin): kill mid-save, then
+        rotate — GC must never delete the only COMMITTED step even when
+        `keep` is exceeded by torn/newer in-flight saves. The guard
+        holds by construction today (`prune` dooms `committed[:-keep]`,
+        which always spares the newest committed step, and torn-dir
+        removal cannot touch a committed one); this test is the tripwire
+        should that invariant ever loosen."""
+        root = str(tmp_path)
+        writer = AsyncCheckpointWriter(root, keep=1)
+        try:
+            writer.save({"params": np.arange(8.0)}, step=0, wait=True)
+            assert list_steps(root) == [0]
+
+            def die_before_commit(fname):
+                if fname == ckfmt.MARKER:
+                    raise RuntimeError("killed before commit")
+
+            # torn NEWER saves exceed keep=1 many times over; the only
+            # committed step must survive every one of them
+            writer.between_files = die_before_commit
+            for step in (1, 2, 3):
+                with pytest.raises(RuntimeError):
+                    writer.save({"params": np.arange(8.0) + step},
+                                step=step, wait=True)
+                assert list_steps(root) == [0], \
+                    f"torn save {step} cost the only committed step"
+                _, manifest = load_tree(root)
+                assert manifest["step"] == 0
+
+            # rotation after recovery: the new commit prunes the torn
+            # leftovers AND the old step, leaving exactly keep=1
+            writer.between_files = None
+            try:  # drain the writer's relayed-error channel first
+                writer.flush()
+            except RuntimeError:
+                pass
+            writer.save({"params": np.arange(8.0) + 9}, step=9,
+                        wait=True)
+            assert list_steps(root) == [9]
+            assert [s for s in os.listdir(root)
+                    if s.startswith("step_")] == \
+                [ckfmt.step_dir_name(9)]
+        finally:
+            writer.between_files = None
+            try:
+                writer.close()
+            except RuntimeError:
+                pass
+
     def test_restore_params_for_reshards_to_target(self, tmp_path):
         from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -148,3 +148,86 @@ def test_checkpoint_every_without_dir_refuses(tmp_path, iris_csv,
                  "-o", str(tmp_path / "m.ckpt"),
                  "--checkpoint-every", "2"]) == 2
     assert "--checkpoint-dir" in capsys.readouterr().err
+
+
+# ----------------------------------------------------- ISSUE 9: resume
+def test_train_resume_auto_discovers_latest_committed(tmp_path, iris_csv,
+                                                      conf_json, capsys):
+    """`--resume auto` restores params+updater+cursor from the newest
+    COMMITTED step under --checkpoint-dir without naming the step dir,
+    and continues the run with the autosave numbering extended."""
+    from deeplearning4j_tpu.checkpoint import format as ckfmt
+
+    ck = str(tmp_path / "ck")
+    assert main(["train", "-i", iris_csv, "-m", conf_json,
+                 "-o", str(tmp_path / "m1.ckpt"), "--epochs", "1",
+                 "--batch-size", "50", "--checkpoint-dir", ck]) == 0
+    capsys.readouterr()
+    first_steps = ckfmt.list_steps(ck)
+    assert first_steps, "first run committed nothing"
+    assert main(["train", "-i", iris_csv, "-m", conf_json,
+                 "-o", str(tmp_path / "m2.ckpt"), "--epochs", "2",
+                 "--batch-size", "50", "--checkpoint-dir", ck,
+                 "--resume", "auto"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    resumed = json.loads(lines[0])
+    assert resumed["resuming"] == ck
+    assert resumed["step"] == first_steps[-1]
+    summary = json.loads(lines[-1])
+    assert summary["resumed_from"] == first_steps[-1]
+    # the resumed run's autosaves EXTEND the numbering (no collision)
+    assert ckfmt.list_steps(ck)[-1] > first_steps[-1]
+
+
+def test_train_resume_auto_torn_only_dir_lists_candidates(
+        tmp_path, iris_csv, conf_json, capsys):
+    import os
+
+    from deeplearning4j_tpu.checkpoint import format as ckfmt
+
+    ck = str(tmp_path / "torn")
+    step_dir = os.path.join(ck, ckfmt.step_dir_name(4))
+    os.makedirs(step_dir)
+    with open(os.path.join(step_dir, ckfmt.MANIFEST), "w") as f:
+        f.write("{}")
+    assert main(["train", "-i", iris_csv, "-m", conf_json,
+                 "-o", str(tmp_path / "m.ckpt"), "--batch-size", "50",
+                 "--checkpoint-dir", ck, "--resume", "auto"]) == 2
+    err = capsys.readouterr().err
+    assert "step_0000000004" in err and "torn" in err
+
+
+def test_train_resume_auto_without_checkpoint_dir_refuses(
+        tmp_path, iris_csv, conf_json, capsys):
+    assert main(["train", "-i", iris_csv, "-m", conf_json,
+                 "-o", str(tmp_path / "m.ckpt"),
+                 "--resume", "auto"]) == 2
+    assert "--checkpoint-dir" in capsys.readouterr().err
+
+
+@pytest.mark.elastic
+def test_train_elastic_smoke(tmp_path, iris_csv, capsys):
+    """`train --elastic N` drives the TrainingSupervisor end to end
+    from the CLI: N spawned workers, every job folded, model saved."""
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(4).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(2).use_adagrad(False).momentum(0.0)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=3)
+            .pretrain(False).build())
+    conf_path = tmp_path / "econf.json"
+    conf_path.write_text(conf.to_json())
+    out_path = str(tmp_path / "elastic.ckpt")
+    assert main(["train", "-i", iris_csv, "-m", str(conf_path),
+                 "-o", out_path, "--elastic", "2", "--epochs", "1",
+                 "--batch-size", "50",
+                 "--checkpoint-dir", str(tmp_path / "eck"),
+                 "--run-timeout", "240"]) == 0
+    summary = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["saved"] == out_path
+    assert summary["workers"] == 2
+    assert summary["folded"] == summary["jobs"] == 3  # ceil(150/50)
+    assert summary["respawns"] == 0

@@ -169,6 +169,170 @@ class TestPreemptionDrill:
         np.testing.assert_array_equal(np.asarray(net2.params()), ref_params)
 
 
+class TestMidEpochFeedResumeExactness:
+    """ISSUE 9 satellite: a killed-and-resumed run fast-forwards
+    `DeviceFeed.cursor` and consumes EXACTLY the unconsumed batches —
+    no skip, no double-train — pinned by a batch-index trace compared
+    against an uninterrupted run, plus bit-identical final params
+    (updater state rides the sharded checkpoint)."""
+
+    def test_trace_covers_stream_exactly_once_and_params_match(
+            self, tmp_path):
+        import os as _os
+        import signal as _signal
+
+        from deeplearning4j_tpu.checkpoint import ShardedModelSaver
+        from deeplearning4j_tpu.checkpoint.restore import restore_network
+        from deeplearning4j_tpu.datasets import ListDataSetIterator
+        from deeplearning4j_tpu.datasets.device_feed import DeviceFeed
+        from deeplearning4j_tpu.optimize.guardian import TrainingPreempted
+
+        class TracingFeed(DeviceFeed):
+            """DeviceFeed that records each trained batch's within-epoch
+            index (cursor - 1 at yield time) into `trace`."""
+
+            def __init__(self, *a, trace=None, **kw):
+                super().__init__(*a, **kw)
+                self.trace = trace if trace is not None else []
+
+            def __iter__(self):
+                for fb in super().__iter__():
+                    self.trace.append(self.cursor - 1)
+                    yield fb
+
+        n_batches, bs, epochs, kill_after = 8, 24, 2, 11
+        batches = _batches(n_batches, bs)
+        x = np.concatenate([bx for bx, _ in batches])
+        y = np.concatenate([by for _, by in batches])
+
+        def feed(trace):
+            return TracingFeed(ListDataSetIterator(DataSet(x, y), bs),
+                               trace=trace)
+
+        # uninterrupted reference over the identical feed pipeline
+        ref_trace: list = []
+        ref = MultiLayerNetwork.from_config_json(_conf().to_json())
+        ref.fit(feed(ref_trace), epochs=epochs)
+        ref_params = np.asarray(ref.params())
+        assert ref_trace == list(range(n_batches)) * epochs
+
+        class KillAt:
+            def __init__(self, at):
+                self.at = at
+                self.count = 0
+
+            def iteration_done(self, model, iteration, score):
+                self.count += 1
+                if self.count == self.at + 1:
+                    _os.kill(_os.getpid(), _signal.SIGTERM)
+
+        ckpt = str(tmp_path / "feed_resume")
+        cut_trace: list = []
+        net = MultiLayerNetwork.from_config_json(_conf().to_json())
+        net.set_listeners([KillAt(kill_after)])
+        saver = ShardedModelSaver(ckpt)
+        with pytest.raises(TrainingPreempted) as exc:
+            net.fit(feed(cut_trace), epochs=epochs, saver=saver,
+                    checkpoint_every=1)
+        saver.close()
+        assert exc.value.position == kill_after + 1
+        del net  # the process is gone
+
+        # fresh process: restore, fast-forward the feed to the
+        # checkpoint's within-epoch cursor, finish the run
+        net2, info = restore_network(ckpt)  # latest committed step
+        assert net2._updater_state is not None
+        position = info["iterator_position"]
+        epoch = info["metadata"]["epoch"]
+        epoch_batch = info["metadata"]["epoch_batch"]
+        assert position == kill_after + 1
+        assert epoch * n_batches + epoch_batch == position
+        resumed_trace: list = []
+        feed2 = feed(resumed_trace)
+        feed2.fast_forward(epoch_batch)
+        net2.fit(feed2, epochs=epochs - epoch,
+                 start_position=position, start_epoch=epoch)
+
+        # the audit: interrupted + resumed traces tile the stream
+        # exactly once — nothing skipped, nothing double-trained
+        assert cut_trace + resumed_trace == ref_trace
+        np.testing.assert_array_equal(np.asarray(net2.params()),
+                                      ref_params)
+
+    def test_double_resume_keeps_epoch_batch_truthful(self, tmp_path):
+        """A RESUMED run that is itself interrupted must checkpoint a
+        truthful within-epoch cursor: the guard's epoch_position is
+        seeded with the restore's epoch_batch, so the SECOND resume
+        fast-forwards past everything actually trained — not just the
+        batches trained since the first resume."""
+        import os as _os
+        import signal as _signal
+
+        from deeplearning4j_tpu.checkpoint import ShardedModelSaver
+        from deeplearning4j_tpu.checkpoint.restore import restore_network
+        from deeplearning4j_tpu.datasets import ListDataSetIterator
+        from deeplearning4j_tpu.datasets.device_feed import DeviceFeed
+        from deeplearning4j_tpu.optimize.guardian import TrainingPreempted
+
+        n_batches, bs = 8, 24
+        batches = _batches(n_batches, bs)
+        x = np.concatenate([bx for bx, _ in batches])
+        y = np.concatenate([by for _, by in batches])
+
+        ref = MultiLayerNetwork.from_config_json(_conf().to_json())
+        ref.fit(ListDataSetIterator(DataSet(x, y), bs))
+        ref_params = np.asarray(ref.params())
+
+        class KillAt:
+            def __init__(self, at):
+                self.at, self.count = at, 0
+
+            def iteration_done(self, model, iteration, score):
+                self.count += 1
+                if self.count == self.at + 1:
+                    _os.kill(_os.getpid(), _signal.SIGTERM)
+
+        ckpt = str(tmp_path / "double")
+        # crash 1 at batch 3 of the single epoch
+        net = MultiLayerNetwork.from_config_json(_conf().to_json())
+        net.set_listeners([KillAt(2)])
+        with pytest.raises(TrainingPreempted):
+            saver = ShardedModelSaver(ckpt)
+            try:
+                net.fit(ListDataSetIterator(DataSet(x, y), bs),
+                        saver=saver, checkpoint_every=1)
+            finally:
+                saver.close()
+        # resume 1, crash again 2 batches later
+        net2, info = restore_network(ckpt)
+        pos1 = info["iterator_position"]
+        eb1 = info["metadata"]["epoch_batch"]
+        assert (pos1, eb1) == (3, 3)
+        net2.set_listeners([KillAt(1)])
+        feed = DeviceFeed(ListDataSetIterator(DataSet(x, y), bs))
+        feed.fast_forward(eb1)
+        with pytest.raises(TrainingPreempted):
+            saver = ShardedModelSaver(ckpt)
+            try:
+                net2.fit(feed, saver=saver, checkpoint_every=1,
+                         start_position=pos1,
+                         start_epoch=info["metadata"]["epoch"],
+                         start_epoch_batch=eb1)
+            finally:
+                saver.close()
+        # resume 2: the cursor must reflect EVERYTHING trained (3 + 2)
+        net3, info2 = restore_network(ckpt)
+        assert info2["iterator_position"] == 5
+        assert info2["metadata"]["epoch_batch"] == 5
+        feed2 = DeviceFeed(ListDataSetIterator(DataSet(x, y), bs))
+        feed2.fast_forward(info2["metadata"]["epoch_batch"])
+        net3.fit(feed2, start_position=info2["iterator_position"],
+                 start_epoch=info2["metadata"]["epoch"],
+                 start_epoch_batch=info2["metadata"]["epoch_batch"])
+        np.testing.assert_array_equal(np.asarray(net3.params()),
+                                      ref_params)
+
+
 def _jobs(n=8, bs=24, seed=1):
     return [DataSet(bx, by) for bx, by in _batches(n, bs, seed)]
 
