@@ -1174,6 +1174,315 @@ def bench_train_elastic():
     }
 
 
+def bench_controlplane():
+    """Control-plane crash-safety drills (ISSUE 10,
+    docs/FAULT_TOLERANCE.md "Who watches the watcher" + docs/FLEET.md
+    "Router restart runbook"). Two REAL-PROCESS drills over the
+    journaled (`--state-dir`) control plane:
+
+    (a) **supervisor-kill drill** — `cli watchdog -- train --elastic 2
+        --state-dir ...`; SIGKILL the supervisor process as soon as a
+        COMMITTED checkpoint proves the run is mid-flight. The
+        watchdog's next incarnation must RE-ADOPT the surviving worker
+        processes (adopted >= 1, zero respawns of live pids) and
+        complete the run with params BIT-IDENTICAL to an uninterrupted
+        reference and `folded == jobs` (zero lost / double-trained
+        examples).
+    (b) **router-kill drill** — `cli fleet --replicas 2 --state-dir`
+        under a /predict hammer; SIGKILL the router process
+        mid-hammer, restart it immediately (the bench plays watchdog).
+        The restarted incarnation must readmit every journaled replica
+        WARM through /readyz: same pids (zero respawns), per-replica
+        compiled-program counts unchanged (zero recompiles), client
+        errors confined to the kill->readmission window, and recovery
+        (restart launch -> first routed success) under 5 s on the CPU
+        smoke.
+    """
+    import signal
+    import tempfile
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu.checkpoint.format import list_steps
+    from deeplearning4j_tpu.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.datasets.iris import load_iris
+    from deeplearning4j_tpu.scaleout.checkpoint import load_checkpoint
+    from deeplearning4j_tpu.testing import chaos as chaos_mod
+
+    work = tempfile.mkdtemp(prefix="dl4j_bench_cp_")
+    x, y = load_iris()
+    data = np.hstack([np.asarray(x),
+                      np.argmax(np.asarray(y), axis=1)[:, None]])
+    csv = os.path.join(work, "iris.csv")
+    np.savetxt(csv, data, delimiter=",", fmt="%.6f")
+    conf_json = (NeuralNetConfiguration.builder()
+                 .lr(0.1).n_in(4).activation_function("tanh")
+                 .optimization_algo("iteration_gradient_descent")
+                 .num_iterations(2).use_adagrad(False).momentum(0.0)
+                 .list(2).hidden_layer_sizes([8])
+                 .override(1, layer="output", loss_function="mcxent",
+                           activation_function="softmax", n_out=3)
+                 .pretrain(False).build().to_json())
+    conf_path = os.path.join(work, "conf.json")
+    with open(conf_path, "w") as f:
+        f.write(conf_json)
+    import sys as _sys
+
+    py = _sys.executable
+
+    def train_args(out):
+        # --straggler-factor 50: compile jitter must not evict anyone
+        # mid-drill (this drill is about the control plane, not the
+        # straggler defense)
+        return ["train", "--elastic", "2", "-i", csv, "-m", conf_path,
+                "-o", out, "--batch-size", "8", "--epochs", "6",
+                "--straggler-factor", "50", "--run-timeout", "240"]
+
+    # ---- (a) supervisor-kill drill --------------------------------
+    ref_out = os.path.join(work, "ref.ckpt")
+    ref = subprocess.run(
+        [py, "-m", "deeplearning4j_tpu.cli"] + train_args(ref_out)
+        + ["--checkpoint-dir", os.path.join(work, "ck_ref")],
+        capture_output=True, text=True, timeout=300, cwd=HERE)
+    if ref.returncode != 0:
+        raise RuntimeError(f"reference elastic run failed: "
+                           f"{ref.stdout[-500:]} {ref.stderr[-500:]}")
+
+    state = os.path.join(work, "state")
+    ck = os.path.join(work, "ck")
+    drill_out = os.path.join(work, "drill.ckpt")
+    cmd = ([py, "-m", "deeplearning4j_tpu.cli", "watchdog",
+            "--max-restarts", "3", "--backoff", "0.2", "--"]
+           + train_args(drill_out)
+           + ["--state-dir", state, "--checkpoint-dir", ck])
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                            cwd=HERE)
+    children, killed, restart_ts = [], [], []
+    drill_sup = {}
+
+    def killer():
+        deadline = time.time() + 240
+        while time.time() < deadline and not killed:
+            if children:
+                try:
+                    if list_steps(ck):
+                        chaos_mod.sigkill(children[0])
+                        killed.append(time.monotonic())
+                        return
+                except (OSError, ProcessLookupError):
+                    return
+            time.sleep(0.05)
+
+    threading.Thread(target=killer, daemon=True).start()
+    lines = []
+    for line in proc.stdout:
+        lines.append(line)
+        if line.startswith("{"):
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if "watchdog_child" in e:
+                children.append(e["watchdog_child"])
+                restart_ts.append(time.monotonic())
+            elif "saved" in e:
+                drill_sup = e
+    rc = proc.wait(timeout=60)
+    sup_restart_s = (round(restart_ts[1] - killed[0], 3)
+                     if killed and len(restart_ts) > 1 else None)
+    ref_net, _ = load_checkpoint(ref_out)
+    sup_bit_identical = False
+    if rc == 0 and os.path.exists(drill_out):
+        drill_net, _ = load_checkpoint(drill_out)
+        sup_bit_identical = bool(np.array_equal(
+            np.asarray(ref_net.params()),
+            np.asarray(drill_net.params())))
+    sup_exact = bool(drill_sup
+                     and drill_sup.get("folded") == drill_sup.get("jobs"))
+    sup_adopted = bool(drill_sup and drill_sup.get("adopted", 0) >= 1
+                       and drill_sup.get("respawns", 1) == 0)
+
+    # ---- (b) router-kill drill ------------------------------------
+    fstate = os.path.join(work, "fstate")
+    fleet_cmd = [py, "-m", "deeplearning4j_tpu.cli", "fleet",
+                 "-m", conf_path, "--replicas", "2",
+                 "--state-dir", fstate,
+                 "--heartbeat-interval", "0.2",
+                 "--request-timeout", "10"]
+
+    def launch_router():
+        p = subprocess.Popen(fleet_cmd, stdout=subprocess.PIPE,
+                             stderr=subprocess.DEVNULL, text=True,
+                             start_new_session=True, cwd=HERE)
+        announce = None
+        for line in p.stdout:
+            if line.startswith("{") and '"router"' in line:
+                announce = json.loads(line)
+                break
+        if announce is None:
+            p.kill()
+            raise RuntimeError("router never announced")
+        # keep draining so the child never blocks on a full pipe
+        threading.Thread(target=lambda: [None for _ in p.stdout],
+                         daemon=True).start()
+        return p, announce
+
+    def get_json(url, timeout=10.0):
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def replica_programs(endpoints):
+        """Per-replica compiled-program counts, scraped from each
+        replica's OWN /stats — unchanged across the router restart
+        means the warm engines never recompiled."""
+        out = {}
+        for url in endpoints:
+            stats = get_json(url + "/stats")
+            out[url] = stats.get("replicas", {}).get(
+                "compiled_programs")
+        return out
+
+    results = []          # (t, ok) per hammer request
+    hammer_stop = threading.Event()
+    router_url = {}
+
+    def hammer():
+        body = json.dumps({"inputs": data[:4, :4].tolist()}).encode()
+        while not hammer_stop.is_set():
+            url = router_url.get("url")
+            if url is None:
+                time.sleep(0.02)
+                continue
+            t = time.monotonic()
+            try:
+                req = urllib.request.Request(
+                    url + "/predict", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    ok = r.status == 200
+            except Exception:
+                ok = False
+            results.append((t, ok))
+            time.sleep(0.01)
+
+    p1 = p2 = None
+    replica_pids = []
+    try:
+        p1, ann1 = launch_router()
+        endpoints = ann1["endpoints"]
+        # both replicas ready before the drill starts
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if get_json(ann1["router"] + "/readyz",
+                        timeout=5).get("ready_replicas", 0) >= 2:
+                break
+            time.sleep(0.1)
+        snap = get_json(ann1["router"] + "/stats")["fleet"]
+        replica_pids = sorted(r["pid"]
+                              for r in snap["replicas"].values()
+                              if "pid" in r)
+        programs_before = replica_programs(endpoints)
+        router_url["url"] = ann1["router"]
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(1.5)  # steady traffic through the warm fleet
+        t_kill = time.monotonic()
+        chaos_mod.sigkill(p1.pid)  # the router process, not the group:
+        # replicas live in their own sessions and must survive
+        t_launch = time.monotonic()
+        p2, ann2 = launch_router()
+        router_url["url"] = ann2["router"]
+        t_announce = time.monotonic()
+        # first routed success after the restart
+        t_ok = None
+        deadline = time.time() + 60
+        while time.time() < deadline and t_ok is None:
+            t_ok = next((t for t, ok in list(results)
+                         if ok and t > t_announce), None)
+            time.sleep(0.02)
+        time.sleep(1.0)  # post-recovery traffic for the window audit
+        hammer_stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        snap2 = get_json(ann2["router"] + "/stats")["fleet"]
+        replica_pids2 = sorted(r["pid"]
+                               for r in snap2["replicas"].values()
+                               if "pid" in r)
+        programs_after = replica_programs(endpoints)
+        failures_after_ok = [t for t, ok in results
+                             if not ok and t_ok and t > t_ok]
+        recovery_s = (round(t_ok - t_launch, 3)
+                      if t_ok is not None else None)
+        error_window_s = (round(t_ok - t_kill, 3)
+                          if t_ok is not None else None)
+        router_drill = {
+            "incarnation": ann2.get("incarnation"),
+            "adopted": ann2.get("adopted"),
+            "replica_pids_before": replica_pids,
+            "replica_pids_after": replica_pids2,
+            "programs_before": programs_before,
+            "programs_after": programs_after,
+            "announce_s": round(t_announce - t_launch, 3),
+            "recovery_s": recovery_s,
+            "error_window_s": error_window_s,
+            "requests": len(results),
+            "failures": sum(1 for _, ok in results if not ok),
+            "failures_after_readmission": len(failures_after_ok),
+        }
+    finally:
+        hammer_stop.set()
+        for p in (p1, p2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+        for pid in replica_pids:
+            try:
+                os.killpg(pid, signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    pass
+
+    gate_router_zero_respawns = bool(
+        router_drill["adopted"] == 2
+        and replica_pids and router_drill["replica_pids_after"]
+        == replica_pids)
+    gate_router_zero_recompiles = bool(
+        router_drill["programs_before"]
+        == router_drill["programs_after"])
+    gate_router_recovery = bool(
+        router_drill["recovery_s"] is not None
+        and router_drill["recovery_s"] <= 5.0)
+    gate_error_window = bool(
+        router_drill["error_window_s"] is not None
+        and router_drill["failures_after_readmission"] == 0
+        and router_drill["error_window_s"]
+        <= router_drill["announce_s"] + 5.0)
+
+    return {
+        "value": router_drill["recovery_s"],
+        "unit": "s_router_restart_to_first_routed_success",
+        "lower_is_better": True,
+        "supervisor_drill": {
+            "rc": rc, "summary": drill_sup,
+            "restart_s": sup_restart_s,
+            "incarnations": len(children),
+            "bit_identical": sup_bit_identical,
+        },
+        "router_drill": router_drill,
+        "gate_supervisor_bit_identical": sup_bit_identical,
+        "gate_supervisor_zero_lost_or_double": sup_exact,
+        "gate_supervisor_adopted_not_respawned": sup_adopted,
+        "gate_router_zero_respawns": gate_router_zero_respawns,
+        "gate_router_zero_recompiles": gate_router_zero_recompiles,
+        "gate_router_recovery_bounded": gate_router_recovery,
+        "gate_router_error_window_bounded": gate_error_window,
+    }
+
+
 def bench_checkpoint():
     """Checkpoint subsystem config (docs/CHECKPOINTS.md): (a) the
     per-autosave STEP-LOOP STALL — blocking single-file npz writer
@@ -1426,6 +1735,7 @@ CONFIGS = {
     "fleet": bench_fleet,
     "chaos": bench_chaos,
     "train_elastic": bench_train_elastic,
+    "controlplane": bench_controlplane,
     "checkpoint": bench_checkpoint,
     "telemetry": bench_telemetry,
     "lenet": bench_lenet,
@@ -1444,6 +1754,7 @@ METRIC_NAMES = {
     "fleet": "fleet_predict_rows_per_sec_4_replicas",
     "chaos": "chaos_sigstop_breaker_eviction_s",
     "train_elastic": "train_elastic_kill_recovery_s",
+    "controlplane": "controlplane_router_restart_recovery_s",
     "checkpoint": "checkpoint_async_save_stall_ms",
     "telemetry": "telemetry_instrumented_step_time_ms",
     "lenet": "lenet_mnist_step_time_ms",

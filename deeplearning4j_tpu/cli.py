@@ -284,10 +284,17 @@ def _cmd_train_elastic(args) -> int:
         batches = [DataSet(x[i:i + bs], y[i:i + bs])
                    for i in range(0, len(x), bs)]
         jobs = [b for _ in range(args.epochs) for b in batches]
-        work = (args.checkpoint_dir
+        state_dir = getattr(args, "state_dir", None)
+        work = (state_dir or args.checkpoint_dir
                 or tempfile.mkdtemp(prefix="dl4j_elastic_"))
         registry_root = os.path.join(work, "_registry")
-        run_name = f"cli-elastic-{os.getpid()}"
+        # with a state dir the run name must be STABLE across control-
+        # plane incarnations: surviving workers rendezvous on it to
+        # reconnect, and the restarted supervisor re-registers it. A
+        # pid-scoped name is only safe when nothing outlives this
+        # process.
+        run_name = ("cli-elastic" if state_dir
+                    else f"cli-elastic-{os.getpid()}")
         sup = TrainingSupervisor(
             CollectionJobIterator(jobs), run_name=run_name,
             registry=ConfigRegistry(registry_root),
@@ -300,7 +307,8 @@ def _cmd_train_elastic(args) -> int:
             resume=args.resume,
             max_respawns=args.max_respawns,
             straggler_factor=args.straggler_factor,
-            status_port=args.status_port)
+            status_port=args.status_port,
+            state_dir=state_dir)
         if sup.status_server is not None:
             print(json.dumps({"status": sup.status_server.address,
                               "workers": args.elastic}), flush=True)
@@ -318,6 +326,9 @@ def _cmd_train_elastic(args) -> int:
                           for k, c in sup._m_evictions.items()
                           if c.value},
             "resumes": len(sup.resume_events),
+            "incarnation": sup.incarnation,
+            "adopted": sum(1 for e in sup.adoption_events
+                           if e["kind"] in ("adopted", "stray")),
             **tele.close()}))
         return 0
     except BaseException:
@@ -409,7 +420,8 @@ def cmd_fleet(args) -> int:
     rolling `POST /reload`, `POST /scale` (docs/FLEET.md)."""
     from deeplearning4j_tpu.serving.fleet import (Autoscaler, Fleet,
                                                   ReplicaSpawner)
-    from deeplearning4j_tpu.serving.router import serve_fleet
+    from deeplearning4j_tpu.serving.router import (ReplicaClient,
+                                                   serve_fleet)
 
     if not args.attach and (not args.model or args.replicas < 1):
         print("fleet needs -m MODEL with --replicas >= 1, and/or "
@@ -433,29 +445,49 @@ def cmd_fleet(args) -> int:
                   breaker_threshold=args.breaker_threshold,
                   breaker_reset_s=args.breaker_reset,
                   autoscaler=autoscaler,
+                  state_dir=args.state_dir,
                   initial_checkpoint=(args.model
                                       if args.model
                                       and not args.model.endswith(".json")
                                       else None))
+    # a crash-restarted router re-adopted its journaled replicas in the
+    # Fleet constructor: only spawn the CAPACITY GAP, never a duplicate
+    # world next to the warm one
+    handoff_exit = bool(args.state_dir) and not args.smoke
     handle = None
     try:
+        attached = {r["url"] for r in
+                    fleet.snapshot()["replicas"].values()}
         for url in args.attach:
-            fleet.attach(url)
+            if ReplicaClient(url).url not in attached:
+                fleet.attach(url)
         if spawner is not None and args.replicas > 0:
-            fleet.spawn(args.replicas)
+            # --replicas counts LOCAL processes: only spawned members
+            # (the adopted warm world) fill the quota — attached URLs
+            # are additive, exactly as on a fresh start
+            have = sum(1 for r in fleet.snapshot()["replicas"].values()
+                       if r["spawned"] and r["state"] != "evicted")
+            if args.replicas > have:
+                fleet.spawn(args.replicas - have)
         handle = serve_fleet(fleet, host=args.host, port=args.port)
         fleet.wait_ready(1, timeout=args.ready_timeout)
     except BaseException:
         if handle is not None:
-            handle.close(stop_replicas=True)
+            handle.close(stop_replicas=not handoff_exit,
+                         handoff=handoff_exit)
         else:
-            fleet.close(stop_replicas=True)
+            fleet.close(stop_replicas=not handoff_exit,
+                        handoff=handoff_exit)
         tele.close()
         raise
     # snapshot() reads membership under the fleet lock — the monitor
     # thread may be autoscale-spawning concurrently
     print(json.dumps({"router": handle.url,
                       "replicas": fleet.state_counts(),
+                      "incarnation": fleet.incarnation,
+                      "adopted": sum(1 for e in fleet.adoption_events
+                                     if e["kind"] in ("adopted",
+                                                      "attached")),
                       "endpoints": [rep["url"] for rep in
                                     fleet.snapshot()["replicas"]
                                     .values()],
@@ -470,9 +502,104 @@ def cmd_fleet(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
-        handle.close(stop_replicas=True)
+        # with a state dir, an exiting router HANDS OFF its warm
+        # replicas for the next incarnation (SIGKILL would anyway —
+        # this makes a graceful stop match); without one, stopping the
+        # router is stopping the fleet
+        handle.close(stop_replicas=not handoff_exit,
+                     handoff=handoff_exit)
         tele.close()
     return 0
+
+
+def cmd_watchdog(args) -> int:
+    """`watchdog -- <subcommand ...>`: restart-under-backoff wrapper so
+    the control plane itself is supervised (docs/FAULT_TOLERANCE.md
+    "Who watches the watcher"). Runs `python -m deeplearning4j_tpu.cli
+    <subcommand ...>` and, while it exits non-zero (crash, OOM-kill,
+    SIGKILL), restarts it with exponential backoff up to
+    `--max-restarts` times. Paired with `--state-dir` on the wrapped
+    `train --elastic` / `fleet`, each restart re-adopts the previous
+    incarnation's journaled children instead of respawning them.
+
+    The child is NOT placed in its own session and NOT registered for
+    the orphan sweep: the watchdog dying must never take the control
+    plane (or transitively the whole run) down with it."""
+    import signal
+    import subprocess
+    import time as _time
+
+    rest = list(args.cmd)
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    if not rest:
+        print("watchdog needs a wrapped subcommand: "
+              "watchdog [opts] -- train --elastic ... --state-dir DIR",
+              file=sys.stderr)
+        return 2
+    if rest[0] == "watchdog":
+        print("watchdog cannot wrap itself", file=sys.stderr)
+        return 2
+    restarts = 0
+    child = None
+
+    def forward(signum, _frame):
+        # operator stop is for the WHOLE plane: forward and stop
+        # restarting (a forwarded SIGTERM exits the child non-zero,
+        # which must not trigger a respawn)
+        if child is not None and child.poll() is None:
+            child.send_signal(signum)
+        raise KeyboardInterrupt
+
+    # both stop signals forward: a process manager signalling only the
+    # watchdog pid (no process-group fan-out like terminal Ctrl-C) must
+    # still reach the child so it can run its graceful handoff close
+    old_term = signal.signal(signal.SIGTERM, forward)
+    old_int = signal.signal(signal.SIGINT, forward)
+    try:
+        while True:
+            # the KeyboardInterrupt guard spans the WHOLE iteration —
+            # forward() raises from arbitrary main-thread points
+            # (mid-Popen, mid-print, mid-backoff), and every one of
+            # them must take the same stop-grace-then-kill exit, never
+            # an uncaught traceback that leaves the child unreaped
+            try:
+                child = subprocess.Popen(
+                    [sys.executable, "-m", "deeplearning4j_tpu.cli"]
+                    + rest)
+                print(json.dumps({"watchdog_child": child.pid,
+                                  "restarts": restarts}), flush=True)
+                rc = child.wait()
+                if rc == 0:
+                    print(json.dumps({"watchdog_done": True,
+                                      "restarts": restarts}),
+                          flush=True)
+                    return 0
+                if restarts >= args.max_restarts:
+                    print(json.dumps({"watchdog_gave_up": True,
+                                      "rc": rc,
+                                      "restarts": restarts}),
+                          flush=True)
+                    return rc if rc > 0 else 1
+                backoff = min(args.backoff * (2 ** restarts),
+                              args.backoff_max)
+                restarts += 1
+                print(json.dumps({"watchdog_restart": restarts,
+                                  "rc": rc,
+                                  "backoff_s": round(backoff, 3)}),
+                      flush=True)
+                _time.sleep(backoff)
+            except KeyboardInterrupt:
+                if child is not None and child.poll() is None:
+                    try:
+                        child.wait(timeout=args.stop_grace)
+                    except subprocess.TimeoutExpired:
+                        child.kill()
+                        child.wait()
+                return 130
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
 
 
 def cmd_checkpoint(args) -> int:
@@ -597,6 +724,14 @@ def build_parser() -> argparse.ArgumentParser:
                               "port (0 = auto-assign)")
     p_train.add_argument("--run-timeout", type=float, default=3600.0,
                          help="elastic: overall run deadline in seconds")
+    p_train.add_argument("--state-dir", default=None, metavar="DIR",
+                         help="elastic: crash-safe control plane — "
+                              "journal supervisor membership here "
+                              "(supervisor.journal) so a restarted "
+                              "supervisor (see `watchdog`) re-adopts "
+                              "its surviving workers warm instead of "
+                              "respawning them "
+                              "(docs/FAULT_TOLERANCE.md)")
     telemetry_flags(p_train)
     p_train.set_defaults(fn=cmd_train)
 
@@ -709,11 +844,38 @@ def build_parser() -> argparse.ArgumentParser:
                          metavar="ARG",
                          help="extra flag forwarded to each spawned "
                               "replica's `serve` (repeatable)")
+    p_fleet.add_argument("--state-dir", default=None, metavar="DIR",
+                         help="crash-safe control plane: journal "
+                              "replica membership here (fleet.journal) "
+                              "so a restarted router (see `watchdog`) "
+                              "re-adopts the warm fleet via /readyz — "
+                              "zero respawns, zero recompiles "
+                              "(docs/FLEET.md router-restart runbook)")
     p_fleet.add_argument("--smoke", action="store_true",
                          help="start, print the address, shut down "
                               "(stops spawned replicas)")
     telemetry_flags(p_fleet)
     p_fleet.set_defaults(fn=cmd_fleet)
+
+    p_watch = sub.add_parser(
+        "watchdog",
+        help="restart-under-backoff wrapper supervising a control-"
+             "plane subcommand (docs/FAULT_TOLERANCE.md)")
+    p_watch.add_argument("--max-restarts", type=int, default=10,
+                         help="give up after this many non-zero exits")
+    p_watch.add_argument("--backoff", type=float, default=1.0,
+                         help="initial restart backoff in seconds "
+                              "(doubles per restart)")
+    p_watch.add_argument("--backoff-max", type=float, default=30.0,
+                         help="backoff ceiling in seconds")
+    p_watch.add_argument("--stop-grace", type=float, default=10.0,
+                         help="seconds a forwarded SIGTERM/SIGINT may "
+                              "take before the child is killed")
+    p_watch.add_argument("cmd", nargs=argparse.REMAINDER,
+                         help="the wrapped subcommand, after `--`: "
+                              "e.g. `-- train --elastic 2 "
+                              "--state-dir S ...`")
+    p_watch.set_defaults(fn=cmd_watchdog)
     return parser
 
 

@@ -116,6 +116,14 @@ def _recv_frame(sock: socket.socket) -> dict:
 
 # ----------------------------------------------------------------- server
 class _TrackerHandler(socketserver.BaseRequestHandler):
+    def setup(self):
+        with self.server.active_lock:  # type: ignore[attr-defined]
+            self.server.active_conns.add(self.request)  # type: ignore
+
+    def finish(self):
+        with self.server.active_lock:  # type: ignore[attr-defined]
+            self.server.active_conns.discard(self.request)  # type: ignore
+
     def handle(self):
         tracker = self.server.tracker  # type: ignore[attr-defined]
         dedup = self.server.dedup  # type: ignore[attr-defined]
@@ -175,6 +183,8 @@ class StateTrackerServer:
         self._server.tracker = tracker  # type: ignore[attr-defined]
         self._server.dedup = {}  # type: ignore[attr-defined]
         self._server.dedup_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._server.active_conns = set()  # type: ignore[attr-defined]
+        self._server.active_lock = threading.Lock()  # type: ignore[attr-defined]
         self.host, self.port = self._server.server_address[:2]
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="tracker-server",
@@ -192,6 +202,22 @@ class StateTrackerServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # sever live worker connections too: a stopped master must look
+        # to its workers exactly like a SIGKILLed one (kernel FIN), or
+        # an in-process restart leaves them talking to a zombie tracker
+        # through handler threads the shutdown never touches
+        with self._server.active_lock:  # type: ignore[attr-defined]
+            conns = list(self._server.active_conns)  # type: ignore
+            self._server.active_conns.clear()  # type: ignore
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 # ----------------------------------------------------------------- client

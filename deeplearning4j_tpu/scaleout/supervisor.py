@@ -41,14 +41,39 @@ orphan sweep) and keeps the RUN alive across worker churn:
   `straggler_factor` is flagged (telemetry + status), and after
   `straggler_strikes` consecutive flags evicted and respawned.
 
+- **Crash-safe control plane** (`state_dir=`): the supervisor itself is
+  no longer the one process nobody may lose. Every membership
+  transition journals (pid + start-time fingerprint, slot, generation,
+  progress port, incarnation) through a `utils/statefile.py` StateFile
+  (`supervisor.journal`, the checkpoint layer's atomic-rename commit
+  idiom), and a restarted incarnation **re-adopts** its live children
+  instead of respawning them: journaled pids are fingerprint-verified
+  (`utils/procs.pid_matches` — pid + /proc start time, never pid
+  alone), surviving workers become `AdoptedProc` members that
+  reconnect warm (`scaleout/worker.py`'s bounded-backoff reconnect
+  loop re-announces `(worker_id, last Job.seq)`), the progress port is
+  rebound from the journal, and run state restores from the last
+  COMMITTED checkpoint so the completed run stays BIT-IDENTICAL with
+  zero lost or double-trained examples. The failure ladder gains a
+  rung above PR 9's: reconnect-adopt -> reshard-resume -> fresh start.
+  A torn journal or dead children degrade one rung, never crash; a
+  crash-exiting incarnation hands its children off
+  (`procs.release_spawned` scopes the atexit sweep to what THIS
+  incarnation still owns) and unknown rejoiners are adopted-or-killed,
+  never leaked. `cli watchdog` supervises the supervisor.
+
 Chaos points (`testing/chaos.py`, env-activated per worker process so
 drills are seeded and replayable): `worker.spawn`, `worker.step`,
-`worker.heartbeat` — see `WorkerSpawner(env_for=...)` for per-worker
-plans. Telemetry: `dl4j_train_fleet_*` (workers-by-state, evictions by
-reason, respawns, resumes, straggler flags, wave latency histogram),
-scraped from the supervisor's StatusServer `/metrics`; `status.json`
-carries per-worker lifecycle and `/healthz` answers 503 when quorum
-(`min_workers`) is lost. Runbook: docs/FAULT_TOLERANCE.md.
+`worker.heartbeat`, `worker.reconnect`, and `supervisor.journal` (the
+journal's write/rename ordinals) — see `WorkerSpawner(env_for=...)`
+for per-worker plans. Telemetry: `dl4j_train_fleet_*`
+(workers-by-state, evictions by reason, respawns, resumes, straggler
+flags, wave latency histogram) plus `dl4j_controlplane_*` (restarts,
+adoptions by kind, journal write/commit histograms, incarnation
+gauge), scraped from the supervisor's StatusServer `/metrics`;
+`status.json` carries per-worker lifecycle and `/healthz` answers 503
+when quorum (`min_workers`) is lost. Runbook: docs/FAULT_TOLERANCE.md
+"Who watches the watcher".
 """
 
 from __future__ import annotations
@@ -73,6 +98,7 @@ from deeplearning4j_tpu.scaleout.launcher import MultiProcessMaster
 from deeplearning4j_tpu.scaleout.runtime import JOBS_DROPPED
 from deeplearning4j_tpu.scaleout.statetracker import InMemoryStateTracker
 from deeplearning4j_tpu.utils import procs
+from deeplearning4j_tpu.utils.statefile import StateFile
 
 __all__ = ["TrainingSupervisor", "WorkerSpawner", "SupervisedWorker",
            "SupervisorAbort", "STARTING", "RUNNING", "SUSPECT",
@@ -111,9 +137,11 @@ class WorkerSpawner:
                  env: Optional[dict] = None,
                  env_for: Optional[Callable[[str], dict]] = None,
                  python: Optional[str] = None,
-                 heartbeat_interval: float = 0.05):
+                 heartbeat_interval: float = 0.05,
+                 reconnect_grace: float = 30.0):
         self.registry_root = str(registry_root)
         self.run_name = run_name
+        self.reconnect_grace = float(reconnect_grace)
         base_env = dict(env) if env is not None else dict(os.environ)
         # the package must be importable in the child whatever cwd the
         # supervisor runs from
@@ -133,7 +161,8 @@ class WorkerSpawner:
                 "--registry", self.registry_root,
                 "--run", self.run_name,
                 "--worker-id", worker_id,
-                "--heartbeat-interval", str(self.heartbeat_interval)]
+                "--heartbeat-interval", str(self.heartbeat_interval),
+                "--reconnect-grace", str(self.reconnect_grace)]
 
     def spawn(self, worker_id: str) -> subprocess.Popen:
         env = dict(self.env)
@@ -180,14 +209,23 @@ class _ProgressListener:
     """
 
     def __init__(self, on_alive, on_progress, on_gone,
-                 host: str = "127.0.0.1", poll_s: float = 0.25):
+                 host: str = "127.0.0.1", poll_s: float = 0.25,
+                 port: int = 0):
         self.on_alive = on_alive
         self.on_progress = on_progress
         self.on_gone = on_gone
         self.poll_s = float(poll_s)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, 0))
+        try:
+            # a restarted incarnation rebinds its journaled port so
+            # surviving workers' reconnects land without a registry
+            # round trip; if something else claimed it meanwhile, fall
+            # back to an ephemeral port — workers re-resolve the fresh
+            # address from the re-registered run config either way
+            self._sock.bind((host, int(port)))
+        except OSError:
+            self._sock.bind((host, 0))
         self._sock.listen(64)
         self.host, self.port = self._sock.getsockname()[:2]
         self._closed = threading.Event()
@@ -292,16 +330,23 @@ class SupervisedWorker:
 
     def __init__(self, worker_id: str, slot: int,
                  proc: Optional[subprocess.Popen] = None,
-                 generation: int = 0):
+                 generation: int = 0, adopted: bool = False):
         self.id = worker_id
         self.slot = slot                # stable index of the capacity slot
         self.generation = generation    # respawn count for this slot
         self.proc = proc
         self.state = STARTING
+        self.adopted = adopted          # re-adopted from a prior incarnation
+        #: /proc start-time fingerprint journaled next to the pid so the
+        #: NEXT incarnation never adopts a recycled pid
+        self.start_time = (getattr(proc, "start_time", None)
+                           or (procs.proc_start_time(proc.pid)
+                               if proc is not None else None))
         self.spawned_at = time.monotonic()
         self.connected = False
         self.performed = 0              # jobs completed (worker-reported)
         self.last_step = 0              # alias surfaced in status.json
+        self.last_seq: Optional[int] = None  # re-announced on reconnect
         self.last_progress_t = time.monotonic()
         self.job_seen_t: Optional[float] = None  # current dispatch seen at
         self.job_seconds: deque = deque(maxlen=8)
@@ -319,6 +364,10 @@ class SupervisedWorker:
                "generation": self.generation,
                "last_step": self.last_step,
                "straggler_strikes": self.straggler_strikes}
+        if self.adopted:
+            out["adopted"] = True
+        if self.last_seq is not None:
+            out["last_seq"] = self.last_seq
         mean = self.mean_job_s()
         if mean is not None:
             out["mean_job_s"] = round(mean, 4)
@@ -359,6 +408,7 @@ class TrainingSupervisor(MultiProcessMaster):
                  host: str = "127.0.0.1",
                  status_port: Optional[int] = None,
                  heartbeat_interval: float = 0.02,
+                 state_dir: Optional[str] = None,
                  **kw):
         if n_workers < 1:
             raise ValueError(f"n_workers must be >= 1, got {n_workers}")
@@ -389,6 +439,32 @@ class TrainingSupervisor(MultiProcessMaster):
         self.resume_events: List[dict] = []
         self._capacity_lost_pending = False
         self._aborted: Optional[str] = None
+
+        # ------------------------------------ crash-safe control plane
+        self.state_dir = state_dir
+        self.journal: Optional[StateFile] = None
+        self.incarnation = 0
+        self.adoption_events: List[dict] = []
+        self._adopt_respawn: List[tuple] = []  # (slot, generation)
+        self._journal_io_lock = threading.Lock()
+        #: strays are only judged once journal adoption has run — a
+        #: survivor reconnecting to the rebound progress port mid-init
+        #: must wait for its journaled record, not be adopted twice
+        self._adoption_done = False
+        prior = None
+        if state_dir is not None:
+            self.journal = StateFile(
+                os.path.join(state_dir, "supervisor.journal"),
+                point="supervisor.journal")
+            prior = self.journal.read()
+            if prior is not None:
+                self.incarnation = int(prior.get("incarnation", 0)) + 1
+            elif self.journal.torn:
+                # a torn journal means a prior incarnation existed but
+                # its children are unknown: spawn fresh under the new
+                # incarnation's namespace and adopt-or-kill whoever
+                # re-announces on the progress plane
+                self.incarnation = 1
         self._init_metrics()
 
         if checkpoint_dir is not None:
@@ -401,7 +477,8 @@ class TrainingSupervisor(MultiProcessMaster):
 
         self._progress = _ProgressListener(
             self._on_worker_alive, self._on_worker_progress,
-            self._on_worker_gone, host=host)
+            self._on_worker_gone, host=host,
+            port=int((prior or {}).get("progress_port") or 0))
 
         super().__init__(
             job_iterator, run_name=run_name, registry=registry,
@@ -421,8 +498,38 @@ class TrainingSupervisor(MultiProcessMaster):
         })
         self.spawner = spawner if spawner is not None else WorkerSpawner(
             getattr(registry, "root", "."), run_name)
+        adopted_any = False
+        if prior is not None:
+            try:
+                adopted_any = self._adopt_prior(prior)
+            except Exception:
+                # a journal that parses but carries an unexpected shape
+                # (older/newer writer, hand edit) must degrade like a
+                # torn one — fresh spawns + stray adopt-or-kill — never
+                # crash the restart into the watchdog's restart budget
+                log.exception(
+                    "supervisor %s: journal adoption failed; falling "
+                    "back to fresh spawns", self.run_label)
         if self._resume_request:
             self._apply_initial_resume(self._resume_request)
+        elif (self.incarnation > 0 and self.checkpoint_dir is not None):
+            # a restarted incarnation implies resume-if-any: the last
+            # COMMITTED checkpoint is the run state the adopted (or
+            # fresh) pool continues from — the reconnect-adopt rung of
+            # the failure ladder degrades to exactly PR 9's elastic
+            # resume when no one survived, and to a fresh start when
+            # nothing committed
+            self._apply_initial_resume("auto")
+        if adopted_any and not self.resume_events:
+            log.warning(
+                "supervisor %s: incarnation %d adopted %d worker(s) "
+                "with no committed checkpoint — continuing from fresh "
+                "params (ladder rung: fresh start, warm processes)",
+                self.run_label, self.incarnation,
+                sum(1 for e in self.adoption_events
+                    if e["kind"] == "adopted"))
+        self._journal_write()
+        self._adoption_done = True
 
     # ------------------------------------------------------- telemetry
     def _init_metrics(self) -> None:
@@ -459,6 +566,15 @@ class TrainingSupervisor(MultiProcessMaster):
                 (lambda st: lambda: (
                     (lambda o: o.state_counts().get(st, 0) if o else 0)(
                         ref())))(state))
+        # crash-safe control plane (docs/OBSERVABILITY.md) — series
+        # definitions shared with the fleet (statefile module)
+        from deeplearning4j_tpu.utils.statefile import \
+            controlplane_metrics
+
+        self._m_restarts, self._m_adoptions = controlplane_metrics(
+            "supervisor", self.run_label,
+            lambda: (lambda o: o.incarnation if o else 0)(ref()),
+            ("adopted", "dead", "recycled", "stray", "killed_stale"))
 
     # ------------------------------------------------------ membership
     def state_counts(self) -> Dict[str, int]:
@@ -474,13 +590,27 @@ class TrainingSupervisor(MultiProcessMaster):
                     if r.state in (STARTING, RUNNING, SUSPECT)]
 
     def _worker_id(self, slot: int, generation: int) -> str:
-        return (f"w{slot}" if generation == 0
+        base = (f"w{slot}" if generation == 0
                 else f"w{slot}r{generation}")
+        # incarnation-scoped ids for FRESH spawns of a restarted
+        # control plane: a prior incarnation's survivor keeps its old
+        # id (it re-announces it), so new spawns must never collide
+        # with a rejoiner wearing the same slot number
+        return base if self.incarnation == 0 \
+            else f"{base}_i{self.incarnation}"
 
     def spawn_workers(self, n: Optional[int] = None) -> None:
-        """Spawn the initial pool (idempotent; run() calls it)."""
+        """Spawn the initial pool (idempotent; run() calls it). A
+        restarted incarnation first replaces journaled slots whose
+        processes did not survive (same slot, bumped generation — not
+        charged to the respawn budget: this is the incarnation's
+        initial pool), then fills any remainder with fresh slots."""
         n = self.n_workers if n is None else n
+        while self._adopt_respawn and len(self.live_workers()) < n:
+            slot, gen = self._adopt_respawn.pop(0)
+            self._spawn_slot(slot, gen)
         with self._sup_lock:
+            self._adopt_respawn.clear()
             have = len(self.live_workers())
         for _ in range(max(0, n - have)):
             slot = next(self._slot_seq)
@@ -495,7 +625,145 @@ class TrainingSupervisor(MultiProcessMaster):
             self.members[wid] = rec
         log.info("supervisor %s: spawned worker %s (pid %d)",
                  self.run_label, wid, proc.pid)
+        self._journal_write()
         return rec
+
+    # ---------------------------------------- crash-safe control plane
+    def _journal_write(self) -> None:
+        """Commit the membership journal (utils/statefile.py atomic
+        rename). Called at every transition: spawn, adopt, evict,
+        close. A failed write is logged and survived — the previous
+        committed journal stays valid, which at worst costs a restart
+        one ladder rung (it adopts a slightly older membership and the
+        pid fingerprints reject anything that changed)."""
+        if self.journal is None:
+            return
+        with self._sup_lock:
+            workers = {}
+            for wid, rec in self.members.items():
+                if rec.state in (EVICTED, DEAD) or rec.proc is None:
+                    continue
+                workers[wid] = {
+                    "slot": rec.slot, "generation": rec.generation,
+                    "pid": rec.proc.pid,
+                    "start_time": rec.start_time,
+                    "state": rec.state,
+                    "performed": rec.performed,
+                    "last_seq": rec.last_seq,
+                }
+            state = {
+                "plane": "supervisor",
+                "run": self.run_label,
+                "incarnation": self.incarnation,
+                "progress_port": self._progress.port,
+                "n_workers": self.n_workers,
+                "respawns_used": self.respawns_used,
+                "checkpoint_dir": self.checkpoint_dir,
+                "workers": workers,
+                "written_at": time.time(),
+            }
+        with self._journal_io_lock:
+            self.journal.try_write(state)
+
+    def _adopt_prior(self, prior: dict) -> bool:
+        """Re-adopt the previous incarnation's live children. Every
+        journaled entry is fingerprint-verified (pid + start time):
+        survivors become AdoptedProc members awaiting their reconnect
+        re-announcement; dead or recycled pids are replaced by fresh
+        spawns of the same slot (bumped generation). Returns True when
+        at least one child was adopted."""
+        self._m_restarts.inc()
+        adopted = False
+        max_slot = -1
+        with self._sup_lock:
+            for wid, w in (prior.get("workers") or {}).items():
+                slot = int(w.get("slot", 0))
+                gen = int(w.get("generation", 0))
+                max_slot = max(max_slot, slot)
+                pid = w.get("pid")
+                kind = procs.classify_pid(pid, w.get("start_time"))
+                if kind == "adopted":
+                    proc = procs.AdoptedProc(pid, w.get("start_time"))
+                    procs.register_spawned(proc)
+                    rec = SupervisedWorker(wid, slot, proc=proc,
+                                           generation=gen, adopted=True)
+                    rec.performed = int(w.get("performed") or 0)
+                    self.members[wid] = rec
+                    adopted = True
+                else:
+                    # "recycled" = alive-but-mismatched start time (a
+                    # stranger wearing the number: never touched, only
+                    # replaced); "dead" is simply replaced
+                    self._adopt_respawn.append((slot, gen + 1))
+                self._m_adoptions[kind].inc()
+                self.adoption_events.append(
+                    {"worker": wid, "kind": kind, "pid": pid,
+                     "slot": slot, "at": time.time()})
+                log.warning("supervisor %s: incarnation %d %s prior "
+                            "worker %s (pid %s)", self.run_label,
+                            self.incarnation,
+                            "re-adopts" if kind == "adopted"
+                            else f"found {kind}", wid, pid)
+            self.respawns_used = int(prior.get("respawns_used")
+                                     or self.respawns_used)
+            # fresh slots must never collide with journaled ones
+            self._slot_seq = itertools.count(max_slot + 1)
+        return adopted
+
+    def _maybe_adopt_stray(self, wid: str, data: dict) -> None:
+        """A progress hello from a worker this incarnation does not
+        know — a survivor the (torn or stale) journal failed to name.
+        Policy: adopted when its (pid, start_time) self-announcement
+        verifies AND the pool has room; otherwise killed. Never
+        ignored: an unknown live worker would keep taking tracker jobs
+        while nobody owns its liveness — the leak this module exists
+        to close."""
+        if self.journal is None or not self._adoption_done:
+            return  # non-journaled supervisors keep the old semantics;
+            # mid-init hellos retry on the reporter's next beat
+        pid = data.get("pid")
+        start_time = data.get("start_time")
+        if not pid:
+            return  # a legacy hello carries no fingerprint: ignore
+        if not procs.pid_matches(int(pid), start_time):
+            return  # claimed fingerprint does not verify: not ours
+        with self._sup_lock:
+            if wid in self.members:
+                return
+            room = len(self.live_workers()) < self.n_workers
+            if room:
+                proc = procs.AdoptedProc(int(pid), start_time)
+                procs.register_spawned(proc)
+                slot = next(self._slot_seq)
+                rec = SupervisedWorker(wid, slot, proc=proc,
+                                       adopted=True)
+                rec.performed = int(data.get("performed") or 0)
+                self.members[wid] = rec
+                self._m_adoptions["stray"].inc()
+                self.adoption_events.append(
+                    {"worker": wid, "kind": "stray", "pid": pid,
+                     "slot": slot, "at": time.time()})
+        if room:
+            log.warning("supervisor %s: adopted stray rejoiner %s "
+                        "(pid %s)", self.run_label, wid, pid)
+            self._journal_write()
+            return
+        # over capacity: adopted-or-killed, never leaked — and never
+        # double-adopted (the members check above is under the lock)
+        log.warning("supervisor %s: killing stray rejoiner %s (pid %s)"
+                    " — pool already whole", self.run_label, wid, pid)
+        self._m_adoptions["killed_stale"].inc()
+        self.adoption_events.append(
+            {"worker": wid, "kind": "killed_stale", "pid": pid,
+             "at": time.time()})
+        self._progress.drop(wid)
+        self.tracker.remove_worker(wid)
+        try:
+            procs.stop_process_group(
+                procs.AdoptedProc(int(pid), start_time),
+                term_first=False)
+        except Exception:
+            log.exception("killing stray worker %s failed", wid)
 
     # -------------------------------------------------- progress plane
     def _rec(self, wid: str) -> Optional[SupervisedWorker]:
@@ -514,10 +782,17 @@ class TrainingSupervisor(MultiProcessMaster):
 
     def _on_worker_progress(self, wid: str, data: dict) -> None:
         rec = self._rec(wid)
-        if rec is None or rec.state in (EVICTED, DEAD):
+        if rec is None:
+            # an unknown rejoiner from a previous incarnation:
+            # adopt-or-kill (never leak, never double-adopt)
+            self._maybe_adopt_stray(wid, data)
+            return
+        if rec.state in (EVICTED, DEAD):
             return
         now = time.monotonic()
         with self._sup_lock:
+            if data.get("last_seq") is not None:
+                rec.last_seq = int(data["last_seq"])
             advanced = False
             performed = int(data.get("performed", rec.performed))
             if performed > rec.performed:
@@ -721,6 +996,7 @@ class TrainingSupervisor(MultiProcessMaster):
                                          retries=orphan.retries,
                                          seq=orphan.seq))
         self._schedule_respawn(rec)
+        self._journal_write()
 
     def _schedule_respawn(self, rec: SupervisedWorker) -> None:
         with self._sup_lock:
@@ -967,17 +1243,53 @@ class TrainingSupervisor(MultiProcessMaster):
     # ------------------------------------------------------ run surface
     def run(self, timeout: float = 300.0) -> np.ndarray:
         self.spawn_workers()
+        ok = False
         try:
             final = super().run(timeout=timeout)
             if self.saver is not None and final is not None:
                 self._save_checkpoint(wait=True)
+            ok = True
             return final
         finally:
-            self.close()
+            # a failing run with a journal HANDS ITS CHILDREN OFF to
+            # the next incarnation (the watchdog restarts us); a clean
+            # finish tears everything down and clears the journal
+            self.close(handoff=not ok)
 
-    def close(self) -> None:
+    def close(self, handoff: bool = False) -> None:
         """Stop worker processes, the progress plane, and the saver.
-        Safe to call repeatedly (run() calls it on every exit path)."""
+        Safe to call repeatedly (run() calls it on every exit path).
+
+        `handoff=True` (only meaningful with a journal): the control
+        plane is dying but the RUN is not — leave the warm worker
+        processes alive for the next incarnation to re-adopt. The
+        journal gets a final commit naming them, they are released
+        from THIS incarnation's atexit orphan sweep
+        (procs.release_spawned — the sweep is scoped to what the
+        current incarnation still owns), and the tracker is NOT
+        finished, so workers enter their bounded reconnect loop
+        instead of exiting."""
+        if handoff and self.journal is not None:
+            with self._sup_lock:
+                self._respawn_queue.clear()
+                recs = [r for r in self.members.values()
+                        if r.proc is not None
+                        and r.state not in (EVICTED, DEAD)]
+            self._journal_write()
+            for rec in recs:
+                procs.release_spawned(rec.proc)
+            log.warning(
+                "supervisor %s: handing %d live worker(s) off to the "
+                "next incarnation (journal %s)", self.run_label,
+                len(recs), self.journal.path)
+            self._progress.close()
+            if self.saver is not None:
+                try:
+                    self.saver.close(timeout=60.0)
+                except Exception:
+                    log.exception("closing checkpoint writer failed")
+                self.saver = None
+            return
         self.tracker.finish()  # workers exit their loops
         with self._sup_lock:
             recs = [r for r in self.members.values()
@@ -995,6 +1307,11 @@ class TrainingSupervisor(MultiProcessMaster):
             except Exception:
                 log.exception("closing checkpoint writer failed")
             self.saver = None
+        if self.journal is not None:
+            # nothing is handed off: a stale journal must not trick
+            # the next incarnation into adopting recycled pids (the
+            # fingerprints would reject them, but why leave the trap)
+            self.journal.clear()
 
     # --------------------------------------------------- observability
     def _status_extra(self) -> Dict[str, Any]:
@@ -1010,6 +1327,9 @@ class TrainingSupervisor(MultiProcessMaster):
             "resumes": list(self.resume_events),
             "folded_jobs": len(self.folded_seqs),
             "checkpoint_dir": self.checkpoint_dir,
+            "incarnation": self.incarnation,
+            "state_dir": self.state_dir,
+            "adoptions": list(self.adoption_events),
         }
 
     def _health(self) -> Dict[str, Any]:
@@ -1020,4 +1340,5 @@ class TrainingSupervisor(MultiProcessMaster):
         return {"ok": live >= self.min_workers,
                 "live_workers": live,
                 "min_workers": self.min_workers,
-                "respawns_used": self.respawns_used}
+                "respawns_used": self.respawns_used,
+                "incarnation": self.incarnation}

@@ -68,11 +68,28 @@ tiebreak — the same policy `ReplicaSet` applies intra-process), with:
   (outstanding-per-replica) signals spawn or retire replicas between
   `min_replicas`/`max_replicas` with a cooldown; `scale_to(n)` is the
   manual twin (router `POST /scale`).
+- **crash-safe control plane** (`state_dir=`): losing the router no
+  longer strands (or worse, recompiles) the warm fleet. Every
+  membership transition journals replica endpoints, states, and spawn
+  fingerprints (pid + /proc start time) through a `utils/statefile.py`
+  StateFile (`fleet.journal`, the checkpoint layer's atomic-rename
+  commit idiom), and a restarted incarnation re-adopts the journaled
+  world instead of respawning it: attached URLs re-attach, spawned
+  replicas whose fingerprints verify become `AdoptedProc` members
+  (released from the previous incarnation's atexit sweep via
+  `procs.release_spawned` on a handoff close — and simply surviving a
+  SIGKILL, which runs no sweep at all), and the ordinary `/readyz`
+  probe readmits each one WARM — zero replica respawns, zero engine
+  recompiles. Dead or recycled pids are skipped (the
+  spawner/autoscaler replaces them); a torn journal degrades to a
+  fresh spawn, never a crash. `cli watchdog` supervises the router
+  itself (docs/FLEET.md "Router restart runbook").
 
-Telemetry (`dl4j_fleet_*`, docs/OBSERVABILITY.md):
-`dl4j_fleet_replicas{state=}` gauges, request/retry/shed/eviction/
-readmission/reload counters, per-route latency histograms,
-`dl4j_fleet_outstanding`.
+Telemetry (`dl4j_fleet_*` + `dl4j_controlplane_*`,
+docs/OBSERVABILITY.md): `dl4j_fleet_replicas{state=}` gauges,
+request/retry/shed/eviction/readmission/reload counters, per-route
+latency histograms, `dl4j_fleet_outstanding`; control-plane restarts,
+adoptions by kind, journal write/commit histograms, incarnation gauge.
 """
 
 from __future__ import annotations
@@ -92,6 +109,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.scaleout.statetracker import InMemoryStateTracker
 from deeplearning4j_tpu.utils import procs
+from deeplearning4j_tpu.utils.statefile import StateFile
 from deeplearning4j_tpu.serving.errors import (DEADLINE_HEADER, Deadline,
                                                OverloadedError)
 from deeplearning4j_tpu.serving.router import ReplicaClient
@@ -212,11 +230,18 @@ class FleetReplica:
     def __init__(self, replica_id: str, client: ReplicaClient,
                  proc: Optional[subprocess.Popen] = None,
                  spawned: bool = False,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 adopted: bool = False):
         self.id = replica_id
         self.client = client
         self.proc = proc
         self.spawned = spawned
+        self.adopted = adopted  # re-adopted from a prior incarnation
+        #: /proc start-time fingerprint journaled next to the pid so a
+        #: restarted router never adopts (or kills) a recycled pid
+        self.start_time = (getattr(proc, "start_time", None)
+                           or (procs.proc_start_time(proc.pid)
+                               if proc is not None else None))
         self.state = STARTING
         self.outstanding = 0
         self.failures = 0          # consecutive request-path failures
@@ -232,6 +257,8 @@ class FleetReplica:
                "outstanding": self.outstanding,
                "failures": self.failures, "spawned": self.spawned,
                "breaker": self.breaker.snapshot()}
+        if self.adopted:
+            out["adopted"] = True
         if self.proc is not None:
             out["pid"] = self.proc.pid
             out["proc_alive"] = self.proc.poll() is None
@@ -398,6 +425,7 @@ class Fleet:
                  autoscaler: Optional[Autoscaler] = None,
                  initial_checkpoint: Optional[str] = None,
                  name: Optional[str] = None,
+                 state_dir: Optional[str] = None,
                  start: bool = True):
         self.spawner = spawner
         self.autoscaler = autoscaler
@@ -437,6 +465,30 @@ class Fleet:
         self._reload_active = False
         self._closed = threading.Event()
         self._monitor: Optional[threading.Thread] = None
+
+        # ------------------------------------ crash-safe control plane
+        self.state_dir = state_dir
+        self.journal: Optional[StateFile] = None
+        self.incarnation = 0
+        self.adoption_events: List[dict] = []
+        self._journal_io_lock = threading.Lock()
+        #: journal writes are suppressed while _adopt_prior runs: each
+        #: attach() inside it would otherwise commit a journal naming
+        #: only the already-adopted SUBSET — a crash mid-adoption would
+        #: then permanently leak the rest of the warm world. One commit
+        #: lands after adoption completes.
+        self._adopting = False
+        self._prior_journal = None
+        if state_dir is not None:
+            self.journal = StateFile(
+                os.path.join(state_dir, "fleet.journal"),
+                point="fleet.journal")
+            self._prior_journal = self.journal.read()
+            if self._prior_journal is not None:
+                self.incarnation = int(
+                    self._prior_journal.get("incarnation", 0)) + 1
+            elif self.journal.torn:
+                self.incarnation = 1  # prior world unknown: fresh spawn
 
         # telemetry ----------------------------------------------------
         reg = telemetry.get_registry()
@@ -520,7 +572,29 @@ class Fleet:
             "in-flight requests across the fleet").labels(
                 **lab).set_function(
             lambda: (lambda o: o.total_outstanding() if o else 0)(ref()))
+        # crash-safe control plane (docs/OBSERVABILITY.md) — series
+        # definitions shared with the supervisor (statefile module)
+        from deeplearning4j_tpu.utils.statefile import \
+            controlplane_metrics
 
+        self._m_restarts, self._m_adoptions = controlplane_metrics(
+            "fleet", self.label,
+            lambda: (lambda o: o.incarnation if o else 0)(ref()),
+            ("adopted", "dead", "recycled", "attached"))
+
+        if self._prior_journal is not None:
+            try:
+                self._adopt_prior(self._prior_journal)
+            except Exception:
+                # an unexpectedly-shaped journal degrades to a fresh
+                # spawn (the torn-journal rung) — never a crash that
+                # burns the watchdog's restart budget
+                log.exception("fleet %s: journal adoption failed; "
+                              "starting fresh", self.label)
+            finally:
+                self._adopting = False  # a failed adoption must not
+                # leave journaling suppressed for the fleet's lifetime
+        self._journal_write()
         if start:
             self.start()
 
@@ -535,18 +609,42 @@ class Fleet:
         return self
 
     def close(self, stop_replicas: bool = False,
-              timeout: float = 10.0) -> None:
+              timeout: float = 10.0, handoff: bool = False) -> None:
         """Stop the monitor; optionally terminate spawned replica
-        processes (attached-by-URL replicas are never touched)."""
+        processes (attached-by-URL replicas are never touched).
+
+        `handoff=True` (only meaningful with a journal): the router is
+        going away but the warm fleet is not — spawned replicas are
+        RELEASED from this incarnation's atexit orphan sweep
+        (procs.release_spawned) and the journal gets a final commit
+        naming them, so the next incarnation re-adopts the whole world
+        through `/readyz` with zero respawns and zero recompiles."""
         self._closed.set()
         if self._monitor is not None:
             self._monitor.join(timeout=timeout)
+        if handoff and self.journal is not None:
+            with self._lock:
+                owned = [r.proc for r in self._replicas.values()
+                         if r.spawned and r.proc is not None]
+            self._journal_write()
+            for proc in owned:
+                procs.release_spawned(proc)
+            log.warning(
+                "fleet %s: handing %d spawned replica(s) off to the "
+                "next incarnation (journal %s)", self.label,
+                len(owned), self.journal.path)
+            return
         if stop_replicas:
             with self._lock:
-                procs = [r.proc for r in self._replicas.values()
+                owned = [r.proc for r in self._replicas.values()
                          if r.spawned and r.proc is not None]
-            for proc in procs:
+            for proc in owned:
                 ReplicaSpawner.stop(proc, timeout=timeout)
+            if self.journal is not None:
+                # a full teardown hands nothing off: clear the journal
+                # so the next incarnation starts fresh instead of
+                # probing dead endpoints
+                self.journal.clear()
 
     def __enter__(self) -> "Fleet":
         return self
@@ -554,22 +652,103 @@ class Fleet:
     def __exit__(self, *exc) -> None:
         self.close(stop_replicas=True)
 
+    # ---------------------------------------- crash-safe control plane
+    def _journal_write(self) -> None:
+        """Commit the fleet journal (utils/statefile.py atomic rename):
+        replica endpoints, states, spawn fingerprints, the serving
+        checkpoint. Called at every membership/state transition. A
+        failed write is logged and survived — the previous committed
+        journal stays valid, and the pid fingerprints reject whatever
+        changed since."""
+        if self.journal is None or self._adopting:
+            return
+        with self._lock:
+            replicas = {}
+            for rid, rep in self._replicas.items():
+                entry = {"url": rep.client.url, "state": rep.state,
+                         "spawned": rep.spawned}
+                if rep.proc is not None:
+                    entry["pid"] = rep.proc.pid
+                    entry["start_time"] = rep.start_time
+                replicas[rid] = entry
+            state = {
+                "plane": "fleet",
+                "fleet": self.label,
+                "incarnation": self.incarnation,
+                "current_checkpoint": self.current_checkpoint,
+                "replicas": replicas,
+                "written_at": time.time(),
+            }
+        with self._journal_io_lock:
+            self.journal.try_write(state)
+
+    def _adopt_prior(self, prior: dict) -> None:
+        """Re-adopt the previous incarnation's journaled world. Every
+        entry re-attaches as STARTING; spawned entries additionally
+        verify their (pid, start-time) fingerprint and become
+        `AdoptedProc` members — the ordinary monitor then readmits
+        each one through `/readyz` WARM: zero respawns, zero
+        recompiles. Dead/recycled pids are skipped (spawner/autoscaler
+        replace them); a recycled pid is never signalled."""
+        self._m_restarts.inc()
+        self._adopting = True
+        if self.current_checkpoint is None:
+            self.current_checkpoint = prior.get("current_checkpoint")
+        max_rid = -1
+        for rid, e in (prior.get("replicas") or {}).items():
+            if rid.startswith("r"):
+                try:
+                    max_rid = max(max_rid, int(rid[1:]))
+                except ValueError:
+                    pass
+            url = e.get("url")
+            if not url:
+                continue
+            pid = e.get("pid")
+            spawned = bool(e.get("spawned"))
+            if spawned and pid:
+                kind = procs.classify_pid(pid, e.get("start_time"))
+                if kind == "adopted":
+                    proc = procs.AdoptedProc(pid, e.get("start_time"))
+                    procs.register_spawned(proc)
+                    self.attach(url, replica_id=rid, proc=proc,
+                                spawned=True, adopted=True)
+            else:
+                # attached-by-URL member: re-attach; the /readyz probe
+                # readmits it (or staleness evicts a dead endpoint)
+                self.attach(url, replica_id=rid, adopted=True)
+                kind = "attached"
+            self._m_adoptions[kind].inc()
+            self.adoption_events.append(
+                {"replica": rid, "kind": kind, "url": url, "pid": pid,
+                 "at": time.time()})
+            log.warning("fleet %s: incarnation %d %s prior replica %s "
+                        "(%s)", self.label, self.incarnation,
+                        "re-adopts" if kind in ("adopted", "attached")
+                        else f"found {kind}", rid, url)
+        with self._lock:
+            # fresh replica ids must never collide with journaled ones
+            self._rid_seq = itertools.count(max_rid + 1)
+        self._adopting = False
+
     # ------------------------------------------------------ membership
     def attach(self, url: str, replica_id: Optional[str] = None,
                proc: Optional[subprocess.Popen] = None,
-               spawned: bool = False) -> FleetReplica:
+               spawned: bool = False,
+               adopted: bool = False) -> FleetReplica:
         """Add a replica endpoint (STARTING until /readyz passes)."""
         with self._lock:
             rid = replica_id or f"r{next(self._rid_seq)}"
             if rid in self._replicas:
                 raise ValueError(f"replica id {rid!r} already attached")
             rep = FleetReplica(rid, ReplicaClient(url), proc=proc,
-                               spawned=spawned,
+                               spawned=spawned, adopted=adopted,
                                breaker=CircuitBreaker(
                                    threshold=self.breaker_threshold,
                                    reset_s=self.breaker_reset_s))
             self._replicas[rid] = rep
         self.tracker.add_worker(rid)
+        self._journal_write()
         return rep
 
     def spawn(self, n: int = 1) -> List[FleetReplica]:
@@ -599,6 +778,7 @@ class Fleet:
         if rep.spawned and rep.proc is not None:
             ReplicaSpawner.stop(rep.proc)
         self._m_retired.inc()
+        self._journal_write()
 
     def scale_to(self, n: int, drain_timeout: float = 30.0) -> dict:
         """Manual autoscaling hook: spawn or retire (least-loaded,
@@ -710,6 +890,7 @@ class Fleet:
             self._m_readmissions.inc()
             log.info("fleet %s: replica %s readmitted", self.label,
                      rep.id)
+        self._journal_write()
 
     def _evict(self, rep: FleetReplica, reason: str) -> None:
         with self._lock:
@@ -724,6 +905,7 @@ class Fleet:
         self._m_evictions.inc()
         log.warning("fleet %s: evicting replica %s (%s)", self.label,
                     rep.id, reason)
+        self._journal_write()
 
     def note_request_failure(self, rep: FleetReplica,
                              exc: BaseException,
@@ -1108,6 +1290,8 @@ class Fleet:
                 return result
             self.current_checkpoint = path
             self._m_reloads["ok"].inc()
+            self._journal_write()  # the serving checkpoint is journaled
+            # state: a restarted router must know the rollback target
             return {"reloaded": True, "path": path, "step": step,
                     "replicas": done}
         finally:
@@ -1202,6 +1386,9 @@ class Fleet:
             "states": self.state_counts(),
             "breakers": self.breaker_counts(),
             "outstanding": self.total_outstanding(),
+            "incarnation": self.incarnation,
+            "state_dir": self.state_dir,
+            "adoptions": list(self.adoption_events),
             "shed_high_water": self.shed_high_water,
             "current_checkpoint": self.current_checkpoint,
             "rolling_reload_active": self._reload_active,

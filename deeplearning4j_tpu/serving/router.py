@@ -158,11 +158,15 @@ class FleetHandle:
     def port(self) -> int:
         return self.http.port
 
-    def close(self, stop_replicas: bool = False) -> None:
+    def close(self, stop_replicas: bool = False,
+              handoff: bool = False) -> None:
         """Stop routing, then stop the fleet's control plane (and the
-        spawned replica processes too when `stop_replicas`)."""
+        spawned replica processes too when `stop_replicas`).
+        `handoff=True` leaves the journaled replicas running for the
+        next router incarnation to re-adopt (docs/FLEET.md "Router
+        restart runbook")."""
         self.http.close()
-        self.fleet.close(stop_replicas=stop_replicas)
+        self.fleet.close(stop_replicas=stop_replicas, handoff=handoff)
 
     def __enter__(self) -> "FleetHandle":
         return self
@@ -214,7 +218,8 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             try:
                 if self.path.startswith("/healthz"):
                     self._reply(200, {"ok": True,
-                                      "replicas": fleet.state_counts()})
+                                      "replicas": fleet.state_counts(),
+                                      "incarnation": fleet.incarnation})
                 elif self.path.startswith("/readyz"):
                     n = fleet.ready_count()
                     self._reply(200 if n else 503,

@@ -85,6 +85,20 @@ POINTS = {
     "worker.heartbeat": "supervised training worker's progress "
                         "reporter, before each progress line (hang/"
                         "delay silence the telemetry plane)",
+    "worker.reconnect": "supervised training worker's supervisor-"
+                        "reconnect loop, before each rejoin attempt "
+                        "after the control plane vanished (error = "
+                        "a worker that fails to rejoin and exits; "
+                        "delay = slow re-announce)",
+    "supervisor.journal": "training control-plane journal "
+                          "(utils/statefile.py), fired with op=write "
+                          "before the tmp write and op=rename before "
+                          "the commit rename — an injected error at "
+                          "ANY ordinal leaves the previous committed "
+                          "journal in place (crash-atomicity drills)",
+    "fleet.journal": "serving control-plane journal (the fleet/router "
+                     "twin of supervisor.journal; same write/rename "
+                     "ordinals and atomicity contract)",
 }
 
 

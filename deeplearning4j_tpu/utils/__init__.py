@@ -1,7 +1,13 @@
 """Utility subsystem (reference core/util/, 27 files ~5.6k LoC — the
 used-by-something subset): Viterbi sequence smoothing, MathUtils,
 disk-spilling queue, pickle-free serialization, moving-window matrix
-extraction, image loading, archive extraction."""
+extraction, image loading, archive extraction; plus the control-plane
+primitives grown beyond parity — spawned-process-group management with
+incarnation handoff (`procs`) and the crash-atomic state journal
+(`statefile.StateFile`, docs/FAULT_TOLERANCE.md "Who watches the
+watcher")."""
+
+from deeplearning4j_tpu.utils.statefile import StateFile  # noqa: F401
 
 from deeplearning4j_tpu.utils.viterbi import Viterbi  # noqa: F401
 from deeplearning4j_tpu.utils.disk_based_queue import (  # noqa: F401

@@ -1,0 +1,606 @@
+"""Control-plane crash-safety drills (ISSUE 10).
+
+PR 9 made workers expendable and PRs 7-8 made replicas expendable; this
+layer makes the processes that OWN them expendable too. The pieces:
+
+- `utils/statefile.py` — the durable journal: crash-atomic at every
+  write/rename ordinal (the checkpoint layer's commit idiom, pinned
+  here by a chaos fault matrix over every ordinal).
+- `utils/procs.py` — incarnation-aware process handling: pid +
+  /proc-start-time fingerprints (`pid_matches`), re-adopted children
+  (`AdoptedProc`), and the handoff that scopes the atexit sweep to
+  what the current incarnation still owns (`release_spawned`).
+- `scaleout/supervisor.py` + `scaleout/worker.py` — a restarted
+  supervisor re-adopts its surviving workers (which reconnect and
+  re-announce instead of dying with the master) and completes the run
+  BIT-IDENTICAL with zero lost or double-folded jobs; torn journals
+  and unknown rejoiners degrade one ladder rung (adopt-or-kill, fresh
+  spawn) — never leak, never double-adopt.
+- `serving/fleet.py` — a restarted router re-adopts journaled replicas
+  through the ordinary `/readyz` probe: warm, zero respawns.
+- `cli watchdog` — the restart-under-backoff wrapper that supervises
+  the control plane itself.
+
+The real SIGKILL-the-process drills live in `bench.py controlplane`
+(gated in BENCH_HISTORY) and the @slow soak below; tier-1 runs the
+deterministic in-process twins.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.datasets.iris import load_iris
+from deeplearning4j_tpu.scaleout.api import CollectionJobIterator
+from deeplearning4j_tpu.scaleout.registry import ConfigRegistry
+from deeplearning4j_tpu.scaleout.supervisor import (TrainingSupervisor,
+                                                    WorkerSpawner)
+from deeplearning4j_tpu.serving import Fleet, serve_network
+from deeplearning4j_tpu.testing import chaos
+from deeplearning4j_tpu.utils import procs
+from deeplearning4j_tpu.utils.statefile import StateFile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- journal
+class TestStateFile:
+    def test_roundtrip_clear_and_torn_detection(self, tmp_path):
+        sf = StateFile(str(tmp_path / "j" / "x.journal"))
+        assert sf.read() is None and not sf.torn
+        sf.write({"n": 1, "workers": {"w0": {"pid": 7}}})
+        assert sf.read() == {"n": 1, "workers": {"w0": {"pid": 7}}}
+        with open(sf.path, "w") as f:
+            f.write('{"n": 2, "work')  # externally torn
+        assert sf.read() is None and sf.torn
+        sf.clear()
+        assert sf.read() is None and not sf.torn
+
+    def test_crash_atomic_at_every_write_and_rename_ordinal(self,
+                                                            tmp_path):
+        """The satellite pin: fault the journal at EVERY write/rename
+        ordinal and require that a reader only ever sees a previously
+        COMMITTED state — the old one before the fault, never a torn
+        or partial one. Each write() hits the chaos point twice
+        (op=write then op=rename), so 5 writes = ordinals 0..9."""
+        n_writes = 5
+        for ordinal in range(2 * n_writes):
+            sf = StateFile(str(tmp_path / f"ord{ordinal}.journal"),
+                           point="supervisor.journal")
+            chaos.configure([chaos.Rule("supervisor.journal", "error",
+                                        at=[ordinal])])
+            committed = None
+            faulted = False
+            try:
+                for i in range(n_writes):
+                    try:
+                        sf.write({"i": i})
+                        committed = {"i": i}
+                    except chaos.ChaosError:
+                        faulted = True
+            finally:
+                chaos.deactivate()
+            assert faulted, f"ordinal {ordinal} never fired"
+            assert sf.read() == committed, (
+                f"ordinal {ordinal}: read {sf.read()!r} "
+                f"!= last committed {committed!r}")
+            assert not sf.torn
+
+    def test_fault_then_recovery_keeps_committing(self, tmp_path):
+        sf = StateFile(str(tmp_path / "rec.journal"),
+                       point="fleet.journal")
+        sf.write({"gen": 0})
+        chaos.configure([chaos.Rule("fleet.journal", "error",
+                                    times=1)])
+        try:
+            with pytest.raises(chaos.ChaosError):
+                sf.write({"gen": 1})
+            sf.write({"gen": 2})  # next commit goes through
+        finally:
+            chaos.deactivate()
+        assert sf.read() == {"gen": 2}
+
+
+# ------------------------------------------------------------- processes
+class TestProcsAdoption:
+    def test_fingerprint_matches_self_and_rejects_recycled(self):
+        st = procs.proc_start_time(os.getpid())
+        assert isinstance(st, int)
+        assert procs.pid_matches(os.getpid(), st)
+        assert not procs.pid_matches(os.getpid(), st + 12345)
+        # a pid that cannot exist
+        assert not procs.pid_matches(2 ** 22 + 1337, None)
+
+    def test_adopted_proc_poll_kill_and_group_stop(self):
+        child = subprocess.Popen(["sleep", "60"],
+                                 start_new_session=True)
+        try:
+            ap = procs.AdoptedProc(child.pid)
+            assert ap.poll() is None
+            assert ap.start_time == procs.proc_start_time(child.pid)
+            procs.register_spawned(ap)
+            # group stop works through the adopted handle (pid==pgid)
+            procs.stop_process_group(ap, term_first=False, timeout=10.0)
+            assert ap.poll() is not None
+            assert ap not in procs.SPAWNED_PROCS
+        finally:
+            if child.poll() is None:
+                child.kill()
+            child.wait()
+
+    def test_dead_and_mismatched_pids_are_never_signalled(self):
+        child = subprocess.Popen(["sleep", "60"],
+                                 start_new_session=True)
+        child.kill()
+        child.wait()
+        ap = procs.AdoptedProc(child.pid)
+        assert ap.poll() == procs.AdoptedProc.UNKNOWN_RC
+        ap.kill()  # no-op, no ProcessLookupError, no stranger killed
+        procs.stop_process_group(ap)  # dead: wait() returns, no killpg
+        # wrong fingerprint on a LIVE pid: treated as not-ours
+        ap2 = procs.AdoptedProc(os.getpid(), start_time=1)
+        assert ap2.poll() is not None
+        ap2.kill()  # must not signal ourselves
+
+    def test_release_scopes_the_atexit_sweep(self):
+        child = subprocess.Popen(["sleep", "60"],
+                                 start_new_session=True)
+        try:
+            procs.register_spawned(child)
+            assert child in procs.SPAWNED_PROCS
+            procs.release_spawned(child)  # handoff: out of the sweep
+            assert child not in procs.SPAWNED_PROCS
+            assert child.poll() is None  # ...and still running
+        finally:
+            child.kill()
+            child.wait()
+
+
+# ----------------------------------------------------- supervisor drills
+def _conf_json():
+    return (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(4).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(2).use_adagrad(False).momentum(0.0)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=3)
+            .pretrain(False).build().to_json())
+
+
+def _jobs(n=6, bs=24, seed=0):
+    x, y = load_iris()
+    x, y = np.asarray(x), np.asarray(y)
+    rng = np.random.RandomState(seed)
+    return [DataSet(x[i], y[i])
+            for i in (rng.choice(len(x), bs, replace=False)
+                      for _ in range(n))]
+
+
+def _supervisor(tmp_path, run_name, jobs, **kw):
+    cj = _conf_json()
+    registry_root = str(tmp_path / f"reg_{run_name}")
+    kw.setdefault("heartbeat_timeout", 3.0)
+    kw.setdefault("progress_timeout", 90.0)
+    return TrainingSupervisor(
+        CollectionJobIterator(list(jobs)), run_name=run_name,
+        registry=ConfigRegistry(registry_root),
+        performer_class=("deeplearning4j_tpu.scaleout.perform."
+                         "NeuralNetWorkPerformer"),
+        performer_conf={"conf_json": cj, "epochs": 1},
+        n_workers=2, conf_json=cj,
+        spawner=WorkerSpawner(registry_root, run_name,
+                              reconnect_grace=120.0), **kw)
+
+
+class _ControlPlaneCrash(RuntimeError):
+    """The injected 'supervisor process died' for in-process drills."""
+
+
+def _crash_after_waves(sup, waves):
+    """Poison the supervision tick: raise once `waves` waves closed —
+    the in-process twin of SIGKILLing the supervisor (the rpc server
+    stop severs worker connections exactly like a kernel FIN)."""
+    orig = sup._tick
+
+    def tick():
+        if sup.waves >= waves:
+            raise _ControlPlaneCrash(f"crashed at wave {sup.waves}")
+        orig()
+
+    sup._tick = tick
+
+
+def _live_pids(sup):
+    out = {}
+    for wid, rec in sup.members.items():
+        if rec.proc is not None and rec.proc.poll() is None:
+            out[wid] = rec.proc.pid
+    return out
+
+
+@pytest.mark.elastic
+class TestSupervisorCrashSafety:
+    def test_restart_adopts_warm_and_completes_bit_identical(
+            self, tmp_path):
+        """The tentpole drill: crash the control plane after two waves;
+        the next incarnation re-adopts BOTH surviving worker processes
+        (same pids, zero respawns), the workers reconnect and
+        re-announce, the run restores from the last COMMITTED
+        checkpoint, and the completed params are BIT-IDENTICAL to an
+        uninterrupted run — with folded_seqs tiling the stream exactly
+        once (zero lost, zero double-folded)."""
+        jobs = _jobs(6)
+        ref = _supervisor(tmp_path, "cpref", jobs).run(timeout=240.0)
+
+        state = str(tmp_path / "state")
+        ck = str(tmp_path / "ck")
+        a = _supervisor(tmp_path, "cprun", jobs, state_dir=state,
+                        checkpoint_dir=ck)
+        _crash_after_waves(a, 2)
+        with pytest.raises(_ControlPlaneCrash):
+            a.run(timeout=240.0)
+        pids_a = {wid: rec.proc.pid for wid, rec in a.members.items()
+                  if rec.proc is not None}
+        journal = a.journal.read()
+        assert journal is not None and journal["workers"], \
+            "handoff never journaled the surviving workers"
+        # the handoff released the children from the atexit sweep
+        for rec in a.members.values():
+            assert rec.proc not in procs.SPAWNED_PROCS
+
+        t0 = time.monotonic()
+        b = _supervisor(tmp_path, "cprun", jobs, state_dir=state,
+                        checkpoint_dir=ck)
+        assert b.incarnation == 1
+        adopted = [e for e in b.adoption_events
+                   if e["kind"] == "adopted"]
+        assert len(adopted) == 2, b.adoption_events
+        assert {e["pid"] for e in adopted} == set(pids_a.values())
+        final = b.run(timeout=240.0)
+        recovery_s = time.monotonic() - t0
+        assert b.respawns_used == 0, "a live pid was respawned"
+        assert sorted(b.folded_seqs) == list(range(len(jobs)))
+        np.testing.assert_array_equal(ref, final)
+        assert b.journal.read() is None, \
+            "clean finish must clear the journal"
+        assert recovery_s < 120.0
+        # adopted members surfaced in status
+        assert any(r.adopted for r in b.members.values())
+
+    def test_stale_journal_from_faulted_writes_still_recovers(
+            self, tmp_path):
+        """Chaos-fault every journal commit after the initial one: the
+        journal the next incarnation reads is STALE (early membership)
+        but its fingerprints still name the surviving pids, so the
+        restart adopts cleanly — a lost journal write costs nothing
+        but staleness, never correctness."""
+        jobs = _jobs(6)
+        state = str(tmp_path / "state")
+        ck = str(tmp_path / "ck")
+        a = _supervisor(tmp_path, "stalerun", jobs, state_dir=state,
+                        checkpoint_dir=ck)
+        _crash_after_waves(a, 2)
+        # ordinals 0..3 are __init__ + first spawn commits; everything
+        # later (including the handoff commit) fails
+        chaos.configure([chaos.Rule("supervisor.journal", "error",
+                                    after=4)])
+        try:
+            with pytest.raises(_ControlPlaneCrash):
+                a.run(timeout=240.0)
+        finally:
+            chaos.deactivate()
+        journal = a.journal.read()
+        assert journal is not None, "the early commits must survive"
+
+        b = _supervisor(tmp_path, "stalerun", jobs, state_dir=state,
+                        checkpoint_dir=ck)
+        final = b.run(timeout=240.0)
+        assert final is not None
+        assert sorted(b.folded_seqs) == list(range(len(jobs)))
+        # never double-adopted: every adopted pid is unique
+        pids = [e["pid"] for e in b.adoption_events
+                if e["kind"] == "adopted"]
+        assert len(pids) == len(set(pids))
+
+    def test_torn_journal_falls_back_and_never_leaks_strays(
+            self, tmp_path):
+        """Corrupt the journal between incarnations: the restart can
+        adopt nobody up front (fresh spawns under the new
+        incarnation's id namespace), and the ORPHANED survivors that
+        re-announce on the progress plane are adopted-or-killed —
+        never leaked, never double-trained."""
+        jobs = _jobs(6)
+        state = str(tmp_path / "state")
+        ck = str(tmp_path / "ck")
+        a = _supervisor(tmp_path, "tornrun", jobs, state_dir=state,
+                        checkpoint_dir=ck)
+        _crash_after_waves(a, 2)
+        with pytest.raises(_ControlPlaneCrash):
+            a.run(timeout=240.0)
+        survivors = _live_pids(a)
+        assert survivors, "drill needs surviving workers"
+        with open(a.journal.path, "w") as f:
+            f.write('{"incarnation": 0, "workers": {"w0"')  # torn
+
+        b = _supervisor(tmp_path, "tornrun", jobs, state_dir=state,
+                        checkpoint_dir=ck, heartbeat_timeout=2.0)
+        assert b.incarnation == 1
+        assert not [e for e in b.adoption_events
+                    if e["kind"] == "adopted"]
+        final = b.run(timeout=240.0)
+        assert final is not None
+        assert sorted(b.folded_seqs) == list(range(len(jobs)))
+        # fresh spawns are incarnation-namespaced (no id collision
+        # with rejoining strays)...
+        fresh = [wid for wid, rec in b.members.items()
+                 if not rec.adopted]
+        assert fresh and all("_i1" in wid for wid in fresh), fresh
+        # ...and no stray survivor outlives the drill: each was either
+        # adopted into the pool or killed, never leaked
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            leaked = {w: p for w, p in survivors.items()
+                      if procs.pid_matches(p, None)}
+            if not leaked:
+                break
+            time.sleep(0.1)
+        assert not leaked, f"stray workers leaked: {leaked}"
+
+
+# ---------------------------------------------------------- fleet drills
+def _net(n_in=4, n_out=3, hidden=8):
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(n_in).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([hidden])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=n_out)
+            .pretrain(False).build())
+    return MultiLayerNetwork(conf)
+
+
+def _poll_until_ready(fleet, n, tries=200):
+    for _ in range(tries):
+        fleet.poll()
+        if fleet.ready_count() >= n:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"only {fleet.ready_count()}/{n} ready: {fleet.state_counts()}")
+
+
+class TestFleetCrashSafety:
+    def test_restarted_router_readmits_warm_with_zero_respawns(
+            self, tmp_path):
+        """Router-restart drill (in-process twin of the bench's
+        SIGKILL): fleet A journals one 'spawned' replica (a real child
+        process fingerprint paired with an in-process endpoint) and
+        one attached URL, then hands off. Fleet B re-adopts both from
+        the journal, readmits them through the ordinary /readyz probe
+        — same pid, same warm endpoint, zero respawns — and serves."""
+        net = _net()
+        h1 = serve_network(net, n_replicas=1, warmup_shape=(4,))
+        h2 = serve_network(net, n_replicas=1, warmup_shape=(4,))
+        sleeper = subprocess.Popen(["sleep", "120"],
+                                   start_new_session=True)
+        procs.register_spawned(sleeper)
+        state = str(tmp_path / "fstate")
+        a = Fleet(start=False, heartbeat_interval=0.1,
+                  heartbeat_timeout=5.0, state_dir=state)
+        b = None
+        try:
+            a.attach(h1.url, proc=sleeper, spawned=True)
+            a.attach(h2.url)
+            _poll_until_ready(a, 2)
+            a.close(handoff=True)
+            assert sleeper not in procs.SPAWNED_PROCS, \
+                "handoff must release the spawned replica"
+            journal = a.journal.read()
+            assert journal and len(journal["replicas"]) == 2
+
+            t0 = time.monotonic()
+            b = Fleet(start=False, heartbeat_interval=0.1,
+                      heartbeat_timeout=5.0, state_dir=state)
+            assert b.incarnation == 1
+            kinds = sorted(e["kind"] for e in b.adoption_events)
+            assert kinds == ["adopted", "attached"], b.adoption_events
+            _poll_until_ready(b, 2)
+            recovery_s = time.monotonic() - t0
+            snap = b.snapshot()
+            spawned = [r for r in snap["replicas"].values()
+                       if r["spawned"]]
+            assert spawned and spawned[0]["pid"] == sleeper.pid
+            assert spawned[0]["adopted"] and spawned[0]["proc_alive"]
+            assert int(b._m_spawned.value) == 0, "a replica respawned"
+            assert recovery_s < 5.0, f"readmission took {recovery_s}s"
+            # ...and the readmitted world actually routes
+            rep = b.select()
+            b.release(rep)
+        finally:
+            for f in (a, b):
+                if f is not None:
+                    f.close()
+            if sleeper.poll() is None:
+                sleeper.kill()
+                sleeper.wait()
+            procs.unregister_spawned(sleeper)
+            h1.close()
+            h2.close()
+
+    def test_dead_and_recycled_pids_are_skipped_not_killed(
+            self, tmp_path):
+        """A journal entry whose pid died (or got recycled by a
+        stranger — wrong start time) is SKIPPED: no adoption, no
+        signal sent, and the spawner/autoscaler owns the replacement."""
+        state = str(tmp_path / "fstate")
+        dead = subprocess.Popen(["sleep", "60"],
+                                start_new_session=True)
+        dead.kill()
+        dead.wait()
+        StateFile(os.path.join(state, "fleet.journal")).write({
+            "plane": "fleet", "incarnation": 3,
+            "current_checkpoint": "/ck/step7",
+            "replicas": {
+                "r0": {"url": "http://127.0.0.1:9", "spawned": True,
+                       "pid": dead.pid, "start_time": 12345},
+                "r1": {"url": "http://127.0.0.1:9", "spawned": True,
+                       "pid": os.getpid(), "start_time": 1},
+            }})
+        b = Fleet(start=False, state_dir=state)
+        try:
+            assert b.incarnation == 4
+            assert b.state_counts()["starting"] == 0  # nothing adopted
+            kinds = {e["replica"]: e["kind"]
+                     for e in b.adoption_events}
+            assert kinds["r0"] == "dead"
+            assert kinds["r1"] == "recycled"
+            # journaled serving checkpoint survives the restart (the
+            # rollback target of the next rolling reload)
+            assert b.current_checkpoint == "/ck/step7"
+            # fresh ids never collide with journaled ones
+            rep = b.attach("http://127.0.0.1:9")
+            assert rep.id == "r2"
+        finally:
+            b.close()
+
+
+# -------------------------------------------------------------- watchdog
+class TestWatchdogCLI:
+    def _run(self, *argv, timeout=120):
+        return subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.cli",
+             "watchdog", *argv],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=REPO_ROOT)
+
+    def test_success_exits_clean_with_zero_restarts(self, tmp_path):
+        from deeplearning4j_tpu.checkpoint import format as ckfmt
+
+        ck = str(tmp_path / "ck")
+        ckfmt.write_checkpoint(ck, 1, {"iterator_position": 1})
+        out = self._run("--max-restarts", "3", "--backoff", "0.05",
+                        "--", "checkpoint", "inspect", ck, "--json")
+        assert out.returncode == 0, out.stderr
+        lines = [json.loads(ln) for ln in out.stdout.splitlines()
+                 if ln.startswith("{")]
+        assert any(e.get("watchdog_done") and e["restarts"] == 0
+                   for e in lines)
+
+    def test_failure_restarts_with_backoff_then_gives_up(self,
+                                                         tmp_path):
+        out = self._run("--max-restarts", "2", "--backoff", "0.05",
+                        "--", "checkpoint", "inspect",
+                        str(tmp_path / "missing"))
+        assert out.returncode != 0
+        lines = [json.loads(ln) for ln in out.stdout.splitlines()
+                 if ln.startswith("{")]
+        restarts = [e for e in lines if "watchdog_restart" in e]
+        assert [e["watchdog_restart"] for e in restarts] == [1, 2]
+        # exponential backoff is visible in the announcements
+        assert restarts[1]["backoff_s"] > restarts[0]["backoff_s"]
+        assert any(e.get("watchdog_gave_up") for e in lines)
+        assert len([e for e in lines if "watchdog_child" in e]) == 3
+
+    def test_refuses_to_wrap_nothing_or_itself(self):
+        out = self._run("--", timeout=60)
+        assert out.returncode == 2
+        out = self._run("--", "watchdog", "--", "x", timeout=60)
+        assert out.returncode == 2
+
+
+# --------------------------------------------------- slow process soaks
+@pytest.mark.slow
+@pytest.mark.elastic
+class TestRealSigkillDrills:
+    def test_sigkill_supervisor_under_watchdog_completes(self,
+                                                         tmp_path):
+        """The real thing: `cli watchdog -- train --elastic 2
+        --state-dir ...`, SIGKILL the supervisor process mid-run, and
+        require the watchdog's next incarnation to re-adopt the
+        surviving workers and finish the run (summary reports
+        adopted>0, incarnation>0)."""
+        x, y = load_iris()
+        data = np.hstack([np.asarray(x),
+                          np.argmax(np.asarray(y), axis=1)[:, None]])
+        csv = str(tmp_path / "iris.csv")
+        np.savetxt(csv, data, delimiter=",", fmt="%.6f")
+        conf = str(tmp_path / "conf.json")
+        with open(conf, "w") as f:
+            f.write(_conf_json())
+        state = str(tmp_path / "state")
+        ck = str(tmp_path / "ck")
+        out_path = str(tmp_path / "model.ckpt")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "deeplearning4j_tpu.cli",
+             "watchdog", "--max-restarts", "3", "--backoff", "0.2",
+             "--", "train", "--elastic", "2", "-i", csv, "-m", conf,
+             "-o", out_path, "--batch-size", "8", "--epochs", "6",
+             "--state-dir", state, "--checkpoint-dir", ck,
+             "--run-timeout", "240"],
+            stdout=subprocess.PIPE, text=True, cwd=REPO_ROOT)
+        children = []
+        killed = []
+
+        def killer():
+            """SIGKILL the FIRST supervisor incarnation as soon as a
+            COMMITTED checkpoint proves the run is mid-flight (the
+            deterministic trigger: warmup is over, waves are folding,
+            work remains)."""
+            from deeplearning4j_tpu.checkpoint.format import list_steps
+
+            deadline = time.time() + 300
+            while time.time() < deadline and not killed:
+                if children:
+                    try:
+                        if list_steps(ck):
+                            chaos.sigkill(children[0])
+                            killed.append(children[0])
+                            return
+                    except (OSError, ProcessLookupError):
+                        return
+                time.sleep(0.05)
+
+        threading.Thread(target=killer, daemon=True).start()
+        lines = []
+        try:
+            deadline = time.time() + 420
+            for line in proc.stdout:
+                lines.append(line)
+                if time.time() > deadline:
+                    break
+                if line.startswith("{"):
+                    try:
+                        e = json.loads(line)
+                    except ValueError:
+                        continue
+                    if "watchdog_child" in e:
+                        children.append(e["watchdog_child"])
+            rc = proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        assert killed, "never saw a committed step to kill behind"
+        assert rc == 0, "".join(lines[-20:])
+        assert len(children) >= 2, \
+            f"watchdog never restarted the supervisor: {lines}"
+        summary = [json.loads(ln) for ln in lines
+                   if ln.startswith("{") and '"saved"' in ln][-1]
+        assert summary["incarnation"] >= 1
+        assert summary["adopted"] >= 1, summary
+        assert summary["folded"] == summary["jobs"]
+        assert os.path.exists(out_path)
