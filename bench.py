@@ -644,6 +644,174 @@ def bench_serve():
             "window_s": round(win_s, 3)}
 
 
+def bench_prefix_cache():
+    """Prefix-cache config (docs/SERVING.md "Prefix caching"). All
+    numbers here are deterministic counters, not timings: the workload
+    is token-for-token identical between a cache-OFF pass and a
+    cache-ON pass, so the ratio of the loops' `prefill_tokens`
+    counters IS the prefill work the cache removed — platform-
+    independent and exactly reproducible. Three phases: (a) the
+    shared-system-prompt drill — N requests share a page-aligned
+    48-token head with short ragged tails, submitted sequentially so
+    each retiree seeds the cache for its successors; gate >= 5x fewer
+    real prefill tokens at bit-identical outputs, with ONE decode-step
+    program and zero recompiles after warmup pinned across the whole
+    run (admitting via cached pages must not mint new programs);
+    (b) multi-turn replay — a conversation resubmits its own growing
+    transcript each turn and the cache re-prefills only the new tail;
+    (c) an end-to-end /metrics scrape off a live server, with a
+    copy-on-write fork forced by replaying a fully cached prompt."""
+    import urllib.request
+
+    import jax
+
+    from deeplearning4j_tpu.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       init_transformer_params)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
+    from deeplearning4j_tpu.serving.engine import InferenceEngine
+    from deeplearning4j_tpu.serving.server import serve_network
+
+    fast = _fast()
+    ps = 8
+    cfg = TransformerConfig(vocab_size=512, d_model=64 if fast else 256,
+                            n_heads=4, n_layers=2,
+                            d_ff=128 if fast else 512,
+                            max_len=128, interpret=fast)
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(7)
+    head = rng.randint(0, cfg.vocab_size, (48,)).astype(np.int32)
+    tails = [2, 3, 4, 5, 6, 4, 4, 4]  # ragged user turns, avg 4
+    drill_prompts = [
+        np.concatenate([head,
+                        rng.randint(0, cfg.vocab_size, (t,)
+                                    ).astype(np.int32)])
+        for t in tails]
+    turns = 4
+    base = rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+    turn_suffixes = [rng.randint(0, cfg.vocab_size, (4,)).astype(np.int32)
+                     for _ in range(turns - 1)]
+    gen_tokens = 4
+
+    def run_pass(enabled):
+        loop = DecodeLoop(params, cfg, slots=4, page_size=ps,
+                          horizon=4, prefix_cache=enabled)
+
+        def gen(prompt):
+            stream = loop.submit(np.asarray(prompt, np.int32),
+                                 gen_tokens)
+            return stream.full_sequence(240)
+
+        outs, programs_after_first = [], None
+        for p in drill_prompts:
+            outs.append(gen(p))
+            if programs_after_first is None:
+                programs_after_first = loop.decode_step_programs()
+        drill_prefill = loop.snapshot()["prefill_tokens"]
+        convo, transcript = base.tolist(), []
+        for t in range(turns):
+            full = list(gen(convo))
+            transcript.append(full)
+            if t < turns - 1:
+                convo = full + turn_suffixes[t].tolist()
+        snap = loop.snapshot()
+        loop.close()
+        return {"outs": outs, "transcript": transcript,
+                "drill_prefill": drill_prefill,
+                "replay_prefill": snap["prefill_tokens"] - drill_prefill,
+                "programs_after_first": programs_after_first,
+                "snap": snap}
+
+    cold = run_pass(False)
+    warm = run_pass(True)
+
+    identical = (cold["outs"] == warm["outs"]
+                 and cold["transcript"] == warm["transcript"])
+    reduction = cold["drill_prefill"] / max(1, warm["drill_prefill"])
+    replay_reduction = (cold["replay_prefill"]
+                        / max(1, warm["replay_prefill"]))
+    step_programs = warm["snap"]["decode_step_programs"]
+    counters_ok = (step_programs >= 0
+                   and warm["programs_after_first"] >= 0)
+    recompiled = step_programs - warm["programs_after_first"]
+    pc = warm["snap"]["prefix_cache"]
+
+    # ---- (c) e2e: the counters must be scrapeable off a live server.
+    # Replaying a fully cached page-aligned prompt makes the first
+    # decode write land in a shared page -> one copy-on-write fork.
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(4).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=3)
+            .pretrain(False).build())
+    gen_engine = InferenceEngine.for_transformer(params, cfg)
+    prompt16 = [head[:16].tolist()]  # 2 full pages
+
+    def post(url, payload):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    def series(text, name):
+        vals = [float(line.rsplit(" ", 1)[1])
+                for line in text.splitlines() if line.startswith(name)]
+        return sum(vals) if vals else -1.0
+
+    with serve_network(MultiLayerNetwork(conf), n_replicas=1,
+                       max_delay_ms=1.0, generate_engine=gen_engine,
+                       slots=2, page_size=ps) as handle:
+        first = post(f"{handle.url}/generate",
+                     {"prompt": prompt16, "max_tokens": 4})
+        replay = post(f"{handle.url}/generate",
+                      {"prompt": prompt16, "max_tokens": 4})
+        with urllib.request.urlopen(f"{handle.url}/metrics",
+                                    timeout=30) as r:
+            metrics_text = r.read().decode()
+    hits_scraped = series(metrics_text, "dl4j_kv_prefix_hits_total")
+    forks_scraped = series(metrics_text, "dl4j_kv_prefix_forks_total")
+    scrape_ok = (replay["tokens"] == first["tokens"]
+                 and hits_scraped >= 1.0 and forks_scraped >= 1.0)
+
+    return {
+        "value": round(reduction, 2),
+        "unit": "x_prefill_token_reduction",
+        "gate_5x": bool(identical and reduction >= 5.0),
+        "outputs_identical": identical,
+        "shared_prompt": {
+            "requests": len(drill_prompts),
+            "head_tokens": int(head.size),
+            "page_size": ps,
+            "prefill_tokens_cold": cold["drill_prefill"],
+            "prefill_tokens_warm": warm["drill_prefill"],
+            "reduction": round(reduction, 2),
+        },
+        "multi_turn": {
+            "turns": turns,
+            "prefill_tokens_cold": cold["replay_prefill"],
+            "prefill_tokens_warm": warm["replay_prefill"],
+            "reduction": round(replay_reduction, 2),
+        },
+        "prefix_cache": {"hits": pc["hits"], "misses": pc["misses"],
+                         "forks": pc["forks"],
+                         "evictions": pc["evictions"],
+                         "pages_cached": pc["pages_cached"]},
+        "decode_step_programs": step_programs if counters_ok else None,
+        "recompiled_after_warmup": recompiled if counters_ok else None,
+        "prefill_ctx_programs": warm["snap"]["prefill_ctx_programs"],
+        "metrics_scrape": {"hits_total": hits_scraped,
+                           "forks_total": forks_scraped,
+                           "replay_bit_identical":
+                               replay["tokens"] == first["tokens"],
+                           "ok": scrape_ok},
+    }
+
+
 def bench_fleet():
     """Fleet config (docs/FLEET.md): (a) scaling curve — aggregate
     /predict rows/sec and client-side p99 through the router over 1 ->
@@ -1732,6 +1900,7 @@ CONFIGS = {
     "feed": bench_feed,
     "guardian": bench_guardian,
     "serve": bench_serve,
+    "prefix_cache": bench_prefix_cache,
     "fleet": bench_fleet,
     "chaos": bench_chaos,
     "train_elastic": bench_train_elastic,
@@ -1751,6 +1920,7 @@ METRIC_NAMES = {
     "feed": "device_feed_ragged_stream_steps_per_sec",
     "guardian": "guardian_guarded_step_time_ms",
     "serve": "serving_decode_tokens_per_sec_cached",
+    "prefix_cache": "serving_prefix_cache_prefill_token_reduction",
     "fleet": "fleet_predict_rows_per_sec_4_replicas",
     "chaos": "chaos_sigstop_breaker_eviction_s",
     "train_elastic": "train_elastic_kill_recovery_s",

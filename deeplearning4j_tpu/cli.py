@@ -386,6 +386,7 @@ def cmd_serve(args) -> int:
             max_delay_ms=args.max_delay_ms,
             max_queue=args.max_queue,
             slots=args.slots, page_size=args.page_size,
+            prefix_cache=args.prefix_cache,
             warmup_shape=(n_in,) if (args.warmup and n_in) else None,
             warmup_async=args.warmup_async)
     except BaseException:
@@ -397,6 +398,7 @@ def cmd_serve(args) -> int:
                       "max_delay_ms": args.max_delay_ms,
                       "slots": args.slots,
                       "page_size": args.page_size,
+                      "prefix_cache": args.prefix_cache,
                       "metrics": handle.url + "/metrics",
                       **tele.announce()}), flush=True)
     if args.smoke:  # start/stop sanity check (tests, deploy probes)
@@ -776,6 +778,12 @@ def build_parser() -> argparse.ArgumentParser:
     p_serve.add_argument("--page-size", type=int, default=16,
                          help="KV page size in tokens for the paged "
                               "decode pool")
+    p_serve.add_argument("--prefix-cache",
+                         action=argparse.BooleanOptionalAction,
+                         default=True,
+                         help="cross-request KV prefix sharing in the "
+                              "decode pool (--no-prefix-cache disables; "
+                              "docs/SERVING.md)")
     p_serve.add_argument("--no-warmup", dest="warmup",
                          action="store_false",
                          help="skip precompiling the bucket programs")

@@ -51,9 +51,33 @@ slot is stalled and nothing can ever free a page, the stalled streams
 fail with a clear error instead of deadlocking — size the pool with
 `paged_kv_bytes` (docs/SERVING.md).
 
+**Prefix caching** (`prefix_cache=True`, the default): a
+content-addressed index (`prefix_cache.PrefixIndex`, a radix trie over
+page-aligned token-id chunks) sits in front of admission. Pages become
+REFCOUNTED: a request whose prompt starts with cached chunks maps those
+pool pages into its page table by reference and prefills only the
+uncovered tail (`paged_prefill_ctx` — the tail attends to the shared
+prefix through the pool); a fully-covered prompt skips prefill
+entirely and replays its last prompt token through the decode step.
+Shared pages are read-only: the first divergent write — the decode
+cursor entering a page another reader or the cache retains —
+copy-on-write forks it into a private page (`copy_page`, the one small
+jitted helper sharing adds; `decode_step_programs()` stays 1 for the
+life of the server). A page returns to the free list only when its
+last reader retires; full PROMPT pages of a retiring request seed the
+cache instead, and an LRU tier evicts unreferenced-but-cached pages on
+demand — the cache never starves live admission or decode growth.
+Because shared pages are read-only until forked, cached-prefix output
+is bit-identical to the cold prefill's by construction for the shared
+positions (tests pin whole-output equality). Per-request opt-out:
+`submit*(..., prefix_cache=False)` neither matches nor seeds the cache
+(secret-bearing prompts must not leak into shared pages).
+
 Telemetry: dl4j_kv_pages_total / dl4j_kv_pages_in_use /
+dl4j_kv_pages_shared / dl4j_kv_pages_cached /
 dl4j_decode_active_slots gauges, dl4j_decode_requests /
-dl4j_decode_tokens_streamed / dl4j_decode_admission_waits counters
+dl4j_decode_tokens_streamed / dl4j_decode_admission_waits /
+dl4j_kv_prefix_{hits,misses,forks,evictions} counters
 (docs/OBSERVABILITY.md).
 """
 
@@ -73,13 +97,17 @@ from deeplearning4j_tpu.models.transformer import TransformerConfig
 from deeplearning4j_tpu.serving.errors import (Deadline,
                                                DeadlineExceededError,
                                                OverloadedError)
-from deeplearning4j_tpu.serving.paged_kv import (init_paged_pool,
+from deeplearning4j_tpu.serving.paged_kv import (copy_page,
+                                                 init_paged_pool,
                                                  paged_decode_step,
                                                  paged_kv_bytes,
                                                  paged_prefill,
+                                                 paged_prefill_ctx,
                                                  pages_for_tokens,
                                                  pages_per_slot,
                                                  prompt_buckets)
+from deeplearning4j_tpu.serving.prefix_cache import PrefixIndex
+from deeplearning4j_tpu.testing import chaos
 from deeplearning4j_tpu.utils.jitcache import jit_cache_size
 
 __all__ = ["GenerationStream", "DecodeLoop"]
@@ -107,6 +135,9 @@ class GenerationStream:
         self.max_tokens = int(max_tokens)
         self.eos_id = None if eos_id is None else int(eos_id)
         self.deadline = deadline
+        #: False = this request neither matches nor seeds the shared
+        #: prefix cache (set by submit_many's per-request opt-out)
+        self.prefix_cache = True
         self.finish_reason: Optional[str] = None
         self.error: Optional[BaseException] = None
         self._generated: List[int] = []
@@ -188,7 +219,7 @@ class GenerationStream:
 
 class _Slot:
     __slots__ = ("stream", "pages", "awaiting_first", "emitted",
-                 "stop_len")
+                 "stop_len", "no_cache")
 
     def __init__(self, stream: GenerationStream, pages: List[int],
                  stop_len: int):
@@ -199,6 +230,9 @@ class _Slot:
         self.awaiting_first = True
         self.emitted = 0          # tokens pushed onto the stream so far
         self.stop_len = stop_len  # final length: prompt + max_tokens - 1
+        #: pages whose bytes diverged from the pure prompt sequence
+        #: (CoW forks) — they must never seed the prefix cache
+        self.no_cache: set = set()
 
 
 class DecodeLoop:
@@ -209,6 +243,7 @@ class DecodeLoop:
     def __init__(self, params, cfg: TransformerConfig, *, slots: int = 8,
                  page_size: int = 16, n_pages: Optional[int] = None,
                  horizon: int = 1, max_waiting: Optional[int] = None,
+                 prefix_cache: bool = True,
                  start: bool = True, name: Optional[str] = None):
         import jax
         import jax.numpy as jnp
@@ -256,6 +291,16 @@ class DecodeLoop:
         #: prefill-group first tokens still on device:
         #: [(device (B,) array, [(row, slot_idx), ...])]
         self._deferred: List = []
+        # prefix sharing: per-page reader refcounts + the chunk trie.
+        # Every page is in exactly ONE of: the free list, in use
+        # (ref > 0), or the cached tier (ref == 0 but trie-retained) —
+        # snapshot()/tests pin that the three always sum to n_pages.
+        self.prefix_cache_enabled = bool(prefix_cache)
+        self._prefix: Optional[PrefixIndex] = (
+            PrefixIndex(self.page_size) if self.prefix_cache_enabled
+            else None)
+        self._ref = np.zeros((self.n_pages,), np.int32)
+        self._prefill_token_count = 0  # real tokens through prefill
 
         # compiled programs -------------------------------------------
         # donation lets XLA update the pool in place on accelerators;
@@ -288,8 +333,22 @@ class DecodeLoop:
                                          page_ids, cfg)
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), pool
 
+        def prefill_ctx_fn(params, tokens, true_len, pool, page_ids,
+                           ctx_table, ctx_len):
+            logits, pool = paged_prefill_ctx(
+                params, tokens, true_len, pool, page_ids, ctx_table,
+                ctx_len, cfg)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), pool
+
+        donate_copy = () if jax.default_backend() == "cpu" else (0,)
         self._step = jax.jit(step_fn, donate_argnums=donate_step)
         self._prefill = jax.jit(prefill_fn, donate_argnums=donate_pre)
+        self._prefill_ctx = jax.jit(prefill_ctx_fn,
+                                    donate_argnums=donate_pre)
+        # the one compiled surface sharing adds: scalar src/dst are
+        # traced, so every CoW fork for the life of the server is ONE
+        # program
+        self._copy = jax.jit(copy_page, donate_argnums=donate_copy)
 
         # queueing / lifecycle ----------------------------------------
         self._cond = threading.Condition()
@@ -331,6 +390,22 @@ class DecodeLoop:
             "generate requests cancelled (client disconnect or "
             "GenerationStream.cancel) — slot retired, pages freed"
         ).labels(**lab)
+        self._m_hits = reg.counter(
+            "dl4j_kv_prefix_hits",
+            "admissions whose prompt matched >= 1 cached prefix chunk "
+            "(shared pool pages mapped by reference)").labels(**lab)
+        self._m_misses = reg.counter(
+            "dl4j_kv_prefix_misses",
+            "cache-eligible admissions that matched no cached chunk "
+            "(full cold prefill)").labels(**lab)
+        self._m_forks = reg.counter(
+            "dl4j_kv_prefix_forks",
+            "copy-on-write page forks (decode cursor entered a shared "
+            "page; it was duplicated into a private one)").labels(**lab)
+        self._m_evictions = reg.counter(
+            "dl4j_kv_prefix_evictions",
+            "unreferenced cached prefix pages evicted (LRU) to satisfy "
+            "an allocation under page pressure").labels(**lab)
         reg.gauge(
             "dl4j_kv_pages_total",
             "usable KV pages in the block pool").labels(**lab).set(
@@ -341,6 +416,18 @@ class DecodeLoop:
             "KV pages currently held by in-flight requests"
         ).labels(**lab).set_function(
             lambda: (lambda o: o.pages_in_use if o else 0)(ref()))
+        reg.gauge(
+            "dl4j_kv_pages_shared",
+            "KV pages an in-flight slot may not write without a CoW "
+            "fork (>= 2 readers, or referenced while cache-retained)"
+        ).labels(**lab).set_function(
+            lambda: (lambda o: o.pages_shared if o else 0)(ref()))
+        reg.gauge(
+            "dl4j_kv_pages_cached",
+            "KV pages retained by the prefix index (the unreferenced "
+            "ones form the LRU-evictable tier)").labels(
+                **lab).set_function(
+            lambda: (lambda o: o.pages_cached if o else 0)(ref()))
         reg.gauge(
             "dl4j_decode_active_slots",
             "slots holding an in-flight request").labels(
@@ -377,16 +464,22 @@ class DecodeLoop:
 
     def submit(self, prompt, max_tokens: int,
                eos_id: Optional[int] = None,
-               deadline: Optional[Deadline] = None) -> GenerationStream:
+               deadline: Optional[Deadline] = None,
+               prefix_cache: bool = True) -> GenerationStream:
         """Queue one prompt (1-D int sequence). The stream's first token
         arrives after admission + prefill; termination on EOS (when
-        given), `max_tokens`, or the model window."""
+        given), `max_tokens`, or the model window. `prefix_cache=False`
+        opts this request out of the shared prefix cache — it neither
+        reuses cached pages nor seeds new ones (benchmark cold runs;
+        secret-bearing prompts)."""
         return self.submit_many([prompt], max_tokens, eos_id,
-                                deadline=deadline)[0]
+                                deadline=deadline,
+                                prefix_cache=prefix_cache)[0]
 
     def submit_many(self, prompts, max_tokens: int,
                     eos_id: Optional[int] = None,
-                    deadline: Optional[Deadline] = None
+                    deadline: Optional[Deadline] = None,
+                    prefix_cache: bool = True
                     ) -> List[GenerationStream]:
         """Admit several rows as ONE unit: all rows enqueue or none do.
         A shed that fired between a multi-row request's submits would
@@ -405,6 +498,7 @@ class DecodeLoop:
         loop_ref = weakref.ref(self)
         for stream in streams:
             stream._loop_ref = loop_ref
+            stream.prefix_cache = bool(prefix_cache)
         with self._cond:
             if self._closed:
                 raise RuntimeError("decode loop is closed")
@@ -417,7 +511,7 @@ class DecodeLoop:
                 free_slots = sum(1 for s in self._slot_state
                                  if s is None)
                 can_now = (not self._waiting
-                           and len(self._free) >= need
+                           and self._avail_pages() >= need
                            and free_slots >= len(prompts))
                 if (not can_now and len(self._waiting) + len(prompts)
                         > self.max_waiting):
@@ -442,7 +536,70 @@ class DecodeLoop:
 
     @property
     def pages_in_use(self) -> int:
-        return self.n_pages - len(self._free)
+        """Pages held by in-flight requests (reader refcount > 0).
+        Cached-but-unreferenced prefix pages are NOT in use — they are
+        reclaimable on demand (`pages_cached`)."""
+        return int(np.count_nonzero(self._ref))
+
+    @property
+    def pages_cached(self) -> int:
+        """Pages retained by the prefix index (shared prefix K/V)."""
+        return 0 if self._prefix is None else len(self._prefix)
+
+    @property
+    def pages_shared(self) -> int:
+        """Pages some in-flight slot may not write in place: >= 2
+        readers, or >= 1 reader while the cache retains the page."""
+        shared = int(np.count_nonzero(self._ref >= 2))
+        if self._prefix is not None:
+            shared += sum(1 for p in self._prefix.pages()
+                          if self._ref[p] == 1)
+        return shared
+
+    def _cached_unref(self) -> int:
+        """The evictable LRU tier: cache-retained pages no slot reads."""
+        if self._prefix is None:
+            return 0
+        return sum(1 for p in self._prefix.pages() if self._ref[p] == 0)
+
+    def _avail_pages(self) -> int:
+        """Pages an allocation could obtain right now: free list plus
+        the evictable cached tier (the cache never starves admission)."""
+        return len(self._free) + self._cached_unref()
+
+    def _alloc_page(self) -> Optional[int]:
+        """Take one page for a new reader (ref -> 1): from the free
+        list, else by LRU-evicting an unreferenced cached prefix page.
+        None when neither has a page (callers stall, not crash)."""
+        if self._free:
+            page = self._free.popleft()
+        elif self._prefix is not None:
+            page = self._prefix.evict_lru(
+                lambda p: self._ref[p] == 0)
+            if page is not None:
+                self._m_evictions.inc()
+        else:
+            page = None
+        if page is not None:
+            self._ref[page] += 1
+        return page
+
+    def _release_page(self, page: int) -> None:
+        """Drop one reader; the page returns to the free list only when
+        the LAST reader is gone AND the cache does not retain it."""
+        self._ref[page] -= 1
+        if self._ref[page] < 0:  # pragma: no cover — accounting bug
+            raise AssertionError(f"page {page} refcount underflow")
+        if (self._ref[page] == 0
+                and (self._prefix is None
+                     or not self._prefix.owns(page))):
+            self._free.append(page)
+
+    def _is_shared(self, page: int) -> bool:
+        """True when a slot must CoW-fork before writing this page."""
+        return (self._ref[page] > 1
+                or (self._prefix is not None
+                    and self._prefix.owns(page)))
 
     @property
     def occupied_slots(self) -> int:
@@ -499,6 +656,20 @@ class DecodeLoop:
                 "dispatches": int(self._m_steps.value),
                 "decode_step_programs": self.decode_step_programs(),
                 "prefill_programs": self.prefill_programs(),
+                "prefill_ctx_programs": jit_cache_size(self._prefill_ctx),
+                "prefill_tokens": self._prefill_token_count,
+                "prefix_cache": {
+                    "enabled": self.prefix_cache_enabled,
+                    "hits": int(self._m_hits.value),
+                    "misses": int(self._m_misses.value),
+                    "forks": int(self._m_forks.value),
+                    "evictions": int(self._m_evictions.value),
+                    "pages_cached": self.pages_cached,
+                    "pages_shared": self.pages_shared,
+                    "cached_unreferenced": self._cached_unref(),
+                    "nodes": (0 if self._prefix is None
+                              else len(self._prefix)),
+                },
             }
 
     def close(self, timeout: float = 30.0) -> None:
@@ -541,7 +712,8 @@ class DecodeLoop:
             self._deferred = []
             for i, slot in enumerate(self._slot_state):
                 if slot is not None:
-                    self._free.extend(slot.pages)
+                    for page in slot.pages:
+                        self._release_page(page)
                     slot.stream._finish("error", exc)
                     self._slot_state[i] = None
             while self._waiting:
@@ -566,7 +738,8 @@ class DecodeLoop:
             # starved of pages that can never come — fail those rather
             # than spin forever
             with self._cond:
-                stuck = (self.occupied_slots > 0 and not self._free
+                stuck = (self.occupied_slots > 0
+                         and self._avail_pages() == 0
                          and all(s is None
                                  or self._stop[i] <= self._lengths[i]
                                  for i, s in enumerate(self._slot_state)))
@@ -616,8 +789,9 @@ class DecodeLoop:
     def _admit(self) -> None:
         import jax.numpy as jnp
 
+        ps = self.page_size
         # claim everything that fits in one lock pass
-        admitted = []  # (slot_idx, stream, pages, plen)
+        admitted = []  # (slot_idx, stream, pages, plen, covered)
         with self._cond:
             used = {i for i, s in enumerate(self._slot_state)
                     if s is not None}
@@ -642,63 +816,151 @@ class DecodeLoop:
                             elapsed_ms=stream.deadline.elapsed_ms()))
                     continue
                 plen = len(stream.prompt)
-                # prompt pages + room for the first decode write: the
-                # admission check that replaces the contiguous path's
-                # whole-max_len reservation
-                need = pages_for_tokens(plen + 1, self.page_size)
                 idx = next((i for i in range(self.slots)
                             if i not in used), None)
-                if idx is None or len(self._free) < need:
+                if idx is None:
+                    self._m_waits.inc()
+                    break
+                # longest cached prefix of FULL page-aligned chunks:
+                # those pool pages are mapped by reference, only the
+                # uncovered tail is prefilled
+                use_cache = (self._prefix is not None
+                             and stream.prefix_cache)
+                matched = (self._prefix.match(stream.prompt)
+                           if use_cache else [])
+                covered = len(matched) * ps
+                # reference the cached run FIRST, so the availability
+                # check and any eviction below can never consume the
+                # very pages this request is about to read
+                for page in matched:
+                    self._ref[page] += 1
+                # uncovered prompt pages + room for the first decode
+                # write (when fully covered, that is the CoW fork's
+                # headroom) — the check that replaces the contiguous
+                # path's whole-max_len reservation
+                need = pages_for_tokens(plen + 1, ps) - len(matched)
+                if self._avail_pages() < need:
+                    for page in matched:
+                        self._release_page(page)
                     self._m_waits.inc()
                     break
                 self._waiting.popleft()
                 used.add(idx)
-                prompt_pages = pages_for_tokens(plen, self.page_size)
-                pages = [self._free.popleft()
-                         for _ in range(prompt_pages)]
-                admitted.append((idx, stream, pages, plen))
+                alloc = pages_for_tokens(plen, ps) - len(matched)
+                pages = list(matched)
+                for _ in range(alloc):
+                    page = self._alloc_page()
+                    if page is None:  # pragma: no cover — availability
+                        raise AssertionError(  # was checked above
+                            "page allocation failed after availability "
+                            "check")
+                    pages.append(page)
+                if use_cache:
+                    (self._m_hits if matched else self._m_misses).inc()
+                admitted.append((idx, stream, pages, plen, covered))
             if admitted:
                 self._peak_pages = max(self._peak_pages,
                                        self.pages_in_use)
         if not admitted:
             return
+        cold = [a for a in admitted if a[4] == 0]
+        warm = [a for a in admitted if 0 < a[4] < a[3]]
+        full = [a for a in admitted if a[4] >= a[3]]
+        # fully-covered prompts skip prefill entirely: the slot starts
+        # ONE position early with its last prompt token pending, so the
+        # first compiled decode dispatch recomputes position plen-1 —
+        # its K/V write re-enters the last shared page, which the CoW
+        # guard forks before the dispatch — and emits the first token.
+        for idx, stream, pages, plen, covered in full:
+            slot = _Slot(stream, pages,
+                         stop_len=plen + stream.max_tokens - 1)
+            slot.awaiting_first = False
+            with self._cond:
+                self._slot_state[idx] = slot
+                self._table[idx, :len(pages)] = pages
+                self._lengths[idx] = plen - 1
+                self._pending[idx] = stream.prompt[-1]
+                self._stop[idx] = 0  # set by _grant_pages
+                self._dirty = True
         # one compiled prefill per (prompt-bucket, batch-bucket) group:
         # an admission burst costs O(groups) dispatches, not O(streams).
         # The prefill is dispatched but NOT synced — first tokens stay
         # on device until the next flush, so back-to-back groups queue
         # without a host round trip between them.
         by_bucket: dict = {}
-        for item in admitted:
+        for item in cold:
             tb = next(b for b in self._buckets if b >= item[3])
             by_bucket.setdefault(tb, []).append(item)
         for tb, group in by_bucket.items():
             bb = 1
             while bb < len(group):
                 bb *= 2
-            n_pids = tb // self.page_size
+            n_pids = tb // ps
             padded = np.zeros((bb, tb), np.int32)
             lens = np.ones((bb,), np.int32)  # pad rows: true_len 1
             pids = np.full((bb, n_pids), self._trash, np.int32)
-            for row, (idx, stream, pages, plen) in enumerate(group):
+            for row, (idx, stream, pages, plen, _cov) in enumerate(group):
                 padded[row, :plen] = stream.prompt
                 lens[row] = plen
                 pids[row, :len(pages)] = pages
+                self._prefill_token_count += plen
             first, self._pool = self._prefill(
                 self.params, jnp.asarray(padded), jnp.asarray(lens),
                 self._pool, jnp.asarray(pids))
-            members = []
-            for row, (idx, stream, pages, plen) in enumerate(group):
-                slot = _Slot(stream, pages,
-                             stop_len=plen + stream.max_tokens - 1)
-                members.append((row, idx))
-                with self._cond:
-                    self._slot_state[idx] = slot
-                    self._table[idx, :len(pages)] = pages
-                    self._lengths[idx] = plen
-                    self._pending[idx] = 0  # real value still on device
-                    self._stop[idx] = 0  # set by _grant_pages
-                    self._dirty = True
-            self._deferred.append((first, members))
+            self._install_prefilled(group, first)
+        # warm tails ride the ctx-aware prefill, bucketed by (cached
+        # pages, tail length) — tails start on a page boundary by
+        # construction (only FULL chunks match)
+        by_ctx: dict = {}
+        for item in warm:
+            idx, stream, pages, plen, covered = item
+            cb = 1
+            while cb < covered // ps:
+                cb *= 2
+            cb = min(cb, self._pps)
+            tb = next(b for b in self._buckets if b >= plen - covered)
+            by_ctx.setdefault((cb, tb), []).append(item)
+        for (cb, tb), group in by_ctx.items():
+            bb = 1
+            while bb < len(group):
+                bb *= 2
+            n_pids = tb // ps
+            padded = np.zeros((bb, tb), np.int32)
+            lens = np.ones((bb,), np.int32)
+            pids = np.full((bb, n_pids), self._trash, np.int32)
+            ctab = np.full((bb, cb), self._trash, np.int32)
+            clen = np.zeros((bb,), np.int32)
+            for row, (idx, stream, pages, plen, cov) in enumerate(group):
+                cp = cov // ps
+                tl = plen - cov
+                padded[row, :tl] = stream.prompt[cov:]
+                lens[row] = tl
+                pids[row, :len(pages) - cp] = pages[cp:]
+                ctab[row, :cp] = pages[:cp]
+                clen[row] = cov
+                self._prefill_token_count += tl
+            first, self._pool = self._prefill_ctx(
+                self.params, jnp.asarray(padded), jnp.asarray(lens),
+                self._pool, jnp.asarray(pids), jnp.asarray(ctab),
+                jnp.asarray(clen))
+            self._install_prefilled(group, first)
+
+    def _install_prefilled(self, group, first) -> None:
+        """Install slots for one prefill group; first tokens stay on
+        device until the next flush (`self._deferred`)."""
+        members = []
+        for row, (idx, stream, pages, plen, _cov) in enumerate(group):
+            slot = _Slot(stream, pages,
+                         stop_len=plen + stream.max_tokens - 1)
+            members.append((row, idx))
+            with self._cond:
+                self._slot_state[idx] = slot
+                self._table[idx, :len(pages)] = pages
+                self._lengths[idx] = plen
+                self._pending[idx] = 0  # real value still on device
+                self._stop[idx] = 0  # set by _grant_pages
+                self._dirty = True
+        self._deferred.append((first, members))
 
     # ---- page granting
     def _grant_pages(self) -> None:
@@ -714,8 +976,10 @@ class DecodeLoop:
                 target = min(length + self.horizon, slot.stop_len)
                 want = pages_for_tokens(target, self.page_size)
                 granted = False
-                while len(slot.pages) < want and self._free:
-                    page = self._free.popleft()
+                while len(slot.pages) < want:
+                    page = self._alloc_page()
+                    if page is None:
+                        break
                     self._table[i, len(slot.pages)] = page
                     slot.pages.append(page)
                     granted = True
@@ -724,11 +988,56 @@ class DecodeLoop:
                                            self.pages_in_use)
                 alloc_end = len(slot.pages) * self.page_size
                 stop = min(slot.stop_len, alloc_end)
+                if stop > length:
+                    stop = self._cow_guard(i, slot, length, stop)
                 if stop <= length and slot.stop_len > length:
                     self._m_waits.inc()  # page-starved this pass
                 if stop != self._stop[i]:
                     self._stop[i] = stop
                     self._dirty = True
+
+    def _cow_guard(self, i: int, slot: _Slot, length: int,
+                   stop: int) -> int:
+        """Copy-on-write fence, run before every dispatch: positions
+        [length, stop) are about to be WRITTEN, so any page in that
+        range that is still shared — mapped by another slot, or
+        retained by the prefix index — is forked into a private copy
+        first (`copy_page` duplicates the exact bytes, so outputs are
+        unchanged). When no page can be obtained for the fork, the
+        slot's stop bound clamps to the shared frontier: the same
+        stall-until-a-retirement-frees-pages backpressure as page
+        granting. Chaos point `decode.fork` fires inside the fork so
+        drills can prove a mid-fork fault leaves page accounting
+        balanced. Caller holds the lock."""
+        import jax.numpy as jnp
+
+        ps = self.page_size
+        for j in range(length // ps, (stop - 1) // ps + 1):
+            page = slot.pages[j]
+            if not self._is_shared(page):
+                continue
+            new = self._alloc_page()
+            if new is None:
+                # fork-under-pressure: hold just before the shared page
+                return max(length, j * ps)
+            try:
+                chaos.hit("decode.fork")
+                self._pool = self._copy(
+                    self._pool, jnp.asarray(page, jnp.int32),
+                    jnp.asarray(new, jnp.int32))
+            except BaseException:
+                # balance the books before propagating: the fresh page
+                # goes straight back (nothing was mapped into it), the
+                # shared page keeps all its readers
+                self._release_page(new)
+                raise
+            slot.pages[j] = new
+            self._table[i, j] = new
+            slot.no_cache.add(new)
+            self._release_page(page)
+            self._m_forks.inc()
+            self._dirty = True
+        return stop
 
     # ---- one compiled dispatch (horizon token steps)
     def _dispatch(self) -> bool:
@@ -814,7 +1123,19 @@ class DecodeLoop:
             self._lengths[idx] = 0
             self._stop[idx] = 0
             self._pending[idx] = 0
-            self._free.extend(slot.pages)
+            if (self._prefix is not None and slot.stream.prefix_cache
+                    and reason in ("eos", "max_tokens")):
+                # seed the cache with the FULL prompt pages only —
+                # decode pages hold this request's continuation, and a
+                # partial prompt page would be rewritten by the next
+                # reader's cursor. Forked pages never seed (no_cache):
+                # their bytes diverged from the pure token sequence.
+                n_full = len(slot.stream.prompt) // self.page_size
+                self._prefix.insert(slot.stream.prompt,
+                                    slot.pages[:n_full],
+                                    skip=slot.no_cache)
+            for page in slot.pages:
+                self._release_page(page)
             self._dirty = True
             self._cond.notify_all()  # admissions may proceed
         slot.stream._finish(reason, error)

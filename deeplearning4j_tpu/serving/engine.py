@@ -191,13 +191,15 @@ class InferenceEngine:
                         page_size: int = 16,
                         kv_pages: Optional[int] = None,
                         max_waiting: Optional[int] = None,
+                        prefix_cache: bool = True,
                         **kw) -> "InferenceEngine":
         """Wrap a transformer LM: apply = full logits (B, T, vocab);
         `generate()` runs the per-request KV-cached compiled scan.
         `decode_slots > 0` additionally starts the continuous-batching
         `DecodeLoop` (paged KV pool, `generate_stream()`); pass
-        `page_size`/`kv_pages` to size the pool and `max_waiting` to
-        bound its admission queue (docs/SERVING.md)."""
+        `page_size`/`kv_pages` to size the pool, `max_waiting` to
+        bound its admission queue, and `prefix_cache=False` to disable
+        cross-request KV prefix sharing (docs/SERVING.md)."""
         from deeplearning4j_tpu.models.transformer import transformer_logits
         from deeplearning4j_tpu.serving.kv_cache import generate_cached
 
@@ -209,7 +211,8 @@ class InferenceEngine:
         if decode_slots:
             eng.start_decode_loop(slots=decode_slots, page_size=page_size,
                                   n_pages=kv_pages,
-                                  max_waiting=max_waiting)
+                                  max_waiting=max_waiting,
+                                  prefix_cache=prefix_cache)
         return eng
 
     @classmethod
@@ -282,7 +285,8 @@ class InferenceEngine:
     def start_decode_loop(self, slots: int = 8, page_size: int = 16,
                           n_pages: Optional[int] = None,
                           horizon: int = 1,
-                          max_waiting: Optional[int] = None):
+                          max_waiting: Optional[int] = None,
+                          prefix_cache: bool = True):
         """Start the continuous-batching slot scheduler
         (serving/decode_loop.py) for this transformer engine: S slots
         over a paged KV pool riding ONE compiled decode step. `/generate`
@@ -300,7 +304,8 @@ class InferenceEngine:
         self.decode_loop = DecodeLoop(self._params, self._tf_cfg,
                                       slots=slots, page_size=page_size,
                                       n_pages=n_pages, horizon=horizon,
-                                      max_waiting=max_waiting)
+                                      max_waiting=max_waiting,
+                                      prefix_cache=prefix_cache)
         return self.decode_loop
 
     def generate_stream(self, prompt, max_tokens: int,

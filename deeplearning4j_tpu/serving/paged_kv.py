@@ -51,7 +51,8 @@ from deeplearning4j_tpu.serving.kv_cache import _ffn, _heads
 
 __all__ = ["PagedKVPool", "init_paged_pool", "paged_kv_bytes",
            "pages_per_slot", "pages_for_tokens", "prompt_buckets",
-           "paged_prefill", "paged_decode_step"]
+           "paged_prefill", "paged_prefill_ctx", "paged_decode_step",
+           "copy_page"]
 
 
 class PagedKVPool(NamedTuple):
@@ -188,6 +189,96 @@ def paged_prefill(params, tokens, true_len, pool: PagedKVPool,
     return last_x @ params["embed"].T, PagedKVPool(tuple(new_layers))
 
 
+def copy_page(pool: PagedKVPool, src, dst) -> PagedKVPool:
+    """Copy-on-write fork helper: duplicate ONE physical page (every
+    layer's K and V rows) from pool index `src` into `dst`. `src`/`dst`
+    are traced int32 scalars, so the jitted caller compiles exactly one
+    program for every fork the server ever performs — the only compiled
+    surface prefix sharing adds (decode_loop.DecodeLoop)."""
+    layers = tuple({"k": layer["k"].at[dst].set(layer["k"][src]),
+                    "v": layer["v"].at[dst].set(layer["v"][src])}
+                   for layer in pool.layers)
+    return PagedKVPool(layers)
+
+
+def paged_prefill_ctx(params, tokens, true_len, pool: PagedKVPool,
+                      page_ids, ctx_table, ctx_len,
+                      cfg: TransformerConfig):
+    """Prefill a batch of prompt TAILS whose prefix K/V already sits in
+    pool pages (the prefix-cache warm path): row b's tokens are prompt
+    positions `[ctx_len[b], ctx_len[b] + true_len[b])`, its cached
+    prefix occupies the pages in `ctx_table[b]` (trash-padded, masked by
+    `ctx_len`), and its tail K/V scatters into `page_ids[b]` exactly
+    like `paged_prefill`. Returns (logits (B, vocab) at each row's last
+    real tail position, updated pool).
+
+    Tails always start on a page boundary (the admission path only
+    reuses FULL cached chunks), so the whole-page scatter reshape is
+    unchanged. Attention is the decode step's exact masked softmax in
+    f32 over [gathered prefix pages ‖ tail], not the flash kernel —
+    tail queries see every real prefix position plus the causal window
+    of the tail itself; masked lanes underflow to exactly 0 so trash /
+    page-tail garbage contributes exactly 0. Shared prefix pages are
+    only READ — sharing stays host-side bookkeeping."""
+    b, tb = tokens.shape
+    ps = pool.page_size
+    hd = cfg.d_model // cfg.n_heads
+    w_ctx = ctx_table.shape[1] * ps
+    pos_ids = jnp.minimum(ctx_len[:, None] + jnp.arange(tb),
+                          cfg.max_len - 1)
+    x = params["embed"][tokens] + params["pos"][pos_ids]
+    flat_ids = page_ids.reshape(-1)
+    # prefix cols real below ctx_len; tail cols causal within the tail
+    m_ctx = jnp.arange(w_ctx)[None, :] < ctx_len[:, None]      # (B, Wc)
+    m_self = (jnp.arange(tb)[None, :] <= jnp.arange(tb)[:, None])
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    new_layers = []
+    for p, layer in zip(params["blocks"], pool.layers):
+        h = _layer_norm(p["ln1"], x)
+        q = _heads(h, p["Wq"], cfg)                   # (B, H, Tb, hd)
+        k = _heads(h, p["Wk"], cfg)
+        v = _heads(h, p["Wv"], cfg)
+        # gather the cached prefix: (B, Pc, H, ps, hd) -> (B, H, Wc, hd)
+        kc = layer["k"][ctx_table].transpose(0, 2, 1, 3, 4).reshape(
+            b, cfg.n_heads, w_ctx, hd)
+        vc = layer["v"][ctx_table].transpose(0, 2, 1, 3, 4).reshape(
+            b, cfg.n_heads, w_ctx, hd)
+        qf = q.astype(jnp.float32)
+        sc_ctx = jnp.einsum("bhqd,bhkd->bhqk", qf,
+                            kc.astype(jnp.float32)) * scale
+        sc_self = jnp.einsum("bhqd,bhkd->bhqk", qf,
+                             k.astype(jnp.float32)) * scale
+        sc = jnp.concatenate([
+            jnp.where(m_ctx[:, None, None, :], sc_ctx, NEG_INF),
+            jnp.where(m_self[None, None, :, :], sc_self, NEG_INF),
+        ], axis=-1)
+        wts = jax.nn.softmax(sc, axis=-1)
+        vf = jnp.concatenate([vc.astype(jnp.float32),
+                              v.astype(jnp.float32)], axis=2)
+        att = jnp.einsum("bhqk,bhkd->bhqd", wts, vf)
+        att = att.astype(x.dtype).transpose(0, 2, 1, 3).reshape(
+            b, tb, cfg.d_model)
+        x = x + att @ p["Wo"]
+        x = _ffn(p, x)
+
+        # (B, H, Tb, hd) -> (B * Tb/ps pages, H, ps, hd) page scatter,
+        # identical to paged_prefill's
+        def pages(arr, like):
+            a = arr.astype(like.dtype)
+            a = a.reshape(b, cfg.n_heads, tb // ps, ps, -1)
+            return a.transpose(0, 2, 1, 3, 4).reshape(
+                b * (tb // ps), cfg.n_heads, ps, -1)
+        new_layers.append({
+            "k": layer["k"].at[flat_ids].set(pages(k, layer["k"])),
+            "v": layer["v"].at[flat_ids].set(pages(v, layer["v"])),
+        })
+    x = _layer_norm(params["ln_f"], x)
+    idx = jnp.broadcast_to((true_len - 1)[:, None, None],
+                           (b, 1, cfg.d_model))
+    last_x = jnp.take_along_axis(x, idx, axis=1)[:, 0, :]
+    return last_x @ params["embed"].T, PagedKVPool(tuple(new_layers))
+
+
 def paged_decode_step(params, tokens, pool: PagedKVPool, page_table,
                       lengths, active, cfg: TransformerConfig):
     """One decode step over S slots: embed `tokens` (S,), write each
@@ -210,10 +301,18 @@ def paged_decode_step(params, tokens, pool: PagedKVPool, page_table,
     window = n_p * ps
     pos = lengths                                          # (S,)
     rows = jnp.arange(s)
-    # physical destination of the incoming token's K/V
-    dest = jnp.where(active, page_table[rows, pos // ps], trash)
+    # physical destination of the incoming token's K/V; a cursor at or
+    # past the window (pos // ps == n_p) writes to trash instead of
+    # clamping into the slot's LAST real page
+    dest = jnp.where(active & (pos // ps < n_p),
+                     page_table[rows, jnp.minimum(pos // ps, n_p - 1)],
+                     trash)
     offset = pos % ps
-    x = (params["embed"][tokens] + params["pos"][pos])[:, None, :]
+    # clamp the position-embedding lookup exactly like paged_prefill:
+    # a slot whose cursor reached the window edge must reuse the last
+    # embedding, not read past the (max_len, d) table
+    pos_ids = jnp.minimum(pos, cfg.max_len - 1)
+    x = (params["embed"][tokens] + params["pos"][pos_ids])[:, None, :]
     mask = jnp.arange(window)[None, :] <= pos[:, None]     # (S, window)
     scale = 1.0 / jnp.sqrt(jnp.float32(hd))
     new_layers = []

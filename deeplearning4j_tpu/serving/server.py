@@ -211,6 +211,7 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                   slots: int = 8, page_size: int = 16,
                   kv_pages: Optional[int] = None,
                   max_waiting: Optional[int] = None,
+                  prefix_cache: bool = True,
                   host: str = "127.0.0.1", port: int = 0,
                   warmup_shape=None,
                   warmup_async: bool = False) -> ServingHandle:
@@ -230,7 +231,10 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
     it lands (how a fleet replica hides its spin-up cost behind the
     router, docs/FLEET.md). `max_queue` bounds the /predict coalescing
     queue and `max_waiting` the /generate admission queue — past
-    either, requests shed with 503 + Retry-After.
+    either, requests shed with 503 + Retry-After. `prefix_cache=False`
+    disables cross-request KV prefix sharing in the decode loop;
+    individual requests opt out with `"prefix_cache": false` in the
+    /generate body.
     """
     if replicas is None:
         if net is None:
@@ -246,7 +250,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
             and generate_engine.decode_loop is None):
         generate_engine.start_decode_loop(slots=slots, page_size=page_size,
                                           n_pages=kv_pages,
-                                          max_waiting=max_waiting)
+                                          max_waiting=max_waiting,
+                                          prefix_cache=prefix_cache)
     batcher = replicas.batcher(max_batch_size=max_batch_size,
                                max_delay_ms=max_delay_ms,
                                max_queue=max_queue)
@@ -442,6 +447,9 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
             eos_id = data.get("eos_id")
             eos_id = None if eos_id is None else int(eos_id)
             streaming = bool(data.get("stream", False))
+            # per-request opt-out: a secret-bearing prompt must neither
+            # read from nor seed the shared prefix cache
+            use_prefix = bool(data.get("prefix_cache", True))
             loop = generate_engine.decode_loop
             if loop is None:
                 # legacy per-request compiled-scan path (no slot
@@ -462,7 +470,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
             # enqueues the whole group under one lock); an expired
             # deadline 504s at submit, and again at slot admission
             streams = loop.submit_many(prompt, max_tokens, eos_id,
-                                       deadline=deadline)
+                                       deadline=deadline,
+                                       prefix_cache=use_prefix)
             if streaming:
                 self._stream_tokens(streams, deadline)
                 return

@@ -69,6 +69,11 @@ POINTS = {
     "server.generate": "before /generate admission into the decode loop",
     "generate.midstream": "between streamed /generate chunks (in-band "
                           "error or hard socket reset mid-stream)",
+    "decode.fork": "decode loop's copy-on-write page fork, after the "
+                   "destination page is claimed (possibly by evicting "
+                   "a cached prefix page) but before the device copy "
+                   "— drills prove mid-fork faults leave pool-page "
+                   "accounting balanced",
     "router.forward": "fleet router, before forwarding to a replica",
     "checkpoint.write": "before each checkpoint shard file write",
     "checkpoint.rename": "before each atomic rename publish "

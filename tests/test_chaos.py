@@ -471,6 +471,75 @@ class TestGenerationStreamCancel:
             assert loop.snapshot()["pages_in_use"] == 0
 
 
+# ------------------------------------------ CoW fork fault (prefix cache)
+class TestDecodeForkFault:
+    def test_mid_fork_eviction_fault_leaves_accounting_balanced(
+            self, tf_setup):
+        """ROADMAP's mid-fork eviction drill: the `decode.fork` fault
+        fires AFTER the destination page was claimed by LRU-evicting a
+        cached prefix page but BEFORE the device copy. The fork path
+        must release the claimed page on the way out — pages in use +
+        free list + cached-unreferenced still sum to `n_pages`, the
+        shared source page keeps its readers, and once the fault clears
+        the retried fork completes the stream with the exact cold-run
+        tokens."""
+        from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
+        from deeplearning4j_tpu.serving.kv_cache import generate_cached
+
+        p, cfg = tf_setup
+        rng = np.random.RandomState(21)
+        s1 = rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+        s2 = rng.randint(0, cfg.vocab_size, (8,)).astype(np.int32)
+        s3 = rng.randint(0, cfg.vocab_size, (8,)).astype(np.int32)
+        import jax.numpy as jnp
+        ref = np.asarray(generate_cached(
+            p, jnp.asarray(s1[None]), cfg, 3))[0].tolist()
+
+        def balance(loop):
+            in_use = loop.pages_in_use
+            free = len(loop._free)
+            cached_unref = loop._cached_unref()
+            assert in_use + free + cached_unref == loop.n_pages, (
+                in_use, free, cached_unref)
+
+        loop = DecodeLoop(p, cfg, slots=2, page_size=8, n_pages=5,
+                          start=False)
+        try:
+            loop.submit(s1, 1)        # seeds 2 cached pages
+            loop.run_until_idle()
+            loop.submit(s2, 1)        # seeds 1 more; 2 pages stay free
+            loop.run_until_idle()
+            assert loop.snapshot()["prefix_cache"]["pages_cached"] == 3
+            c = loop.submit(s3, 12)   # cold; grows to drain the free list
+            for _ in range(200):
+                loop.tick()
+                if not loop._free and loop.occupied_slots:
+                    break
+            assert not loop._free and not c.done
+            # B full-hits s1: its CoW fork can only get a page by
+            # evicting s2's cached entry — and the fault fires mid-fork
+            b = loop.submit(s1, 3)
+            chaos.configure([Rule("decode.fork", "error", at=[0])])
+            with pytest.raises(ChaosError):
+                loop.tick()
+            balance(loop)
+            snap = loop.snapshot()["prefix_cache"]
+            assert snap["evictions"] == 1     # s2's page was consumed...
+            assert snap["forks"] == 0         # ...but no fork completed
+            assert loop._prefix.match(list(s2)) == []
+            assert loop._prefix.match(list(s1)) != []  # source intact
+            assert not b.done                 # B stalled, not failed
+            chaos.deactivate()
+            loop.run_until_idle()             # retried fork succeeds
+            assert b.full_sequence(10) == s1.tolist() + ref[16:]
+            assert c.result(10) is not None
+            snap = loop.snapshot()["prefix_cache"]
+            assert snap["forks"] == 1  # the retry, once
+            balance(loop)
+        finally:
+            loop.close()
+
+
 # ============================================= HTTP surface: 504s, resets
 class TestServerDeadlinesHTTP:
     def test_expired_deadline_is_504_machine_readable_no_compute(self):

@@ -383,6 +383,46 @@ class TestRecompileGuard:
             assert loop.decode_step_programs() == 1
 
 
+# ---------------------------------------------- window-edge regression
+class TestWindowEdge:
+    """ISSUE 12 satellite: `paged_decode_step` indexed
+    `params["pos"][pos]` unclamped while `paged_prefill` clamps — a
+    cursor AT the window edge must reuse the last position embedding,
+    not read past the (max_len, d) table."""
+
+    def test_generation_to_the_exact_window_edge(self):
+        """prompt + max_tokens == max_len: the slot decodes to the last
+        writable position and still matches the contiguous reference
+        token-for-token."""
+        p = _params()
+        rng = np.random.RandomState(20)
+        pr = _prompt(rng, 34)
+        n = CFG.max_len - len(pr)  # 30: the largest budget validate allows
+        ref = _ref_tokens(p, pr, n)
+        with DecodeLoop(p, CFG, slots=1, page_size=8) as loop:
+            st = loop.submit(pr, n)
+            assert st.full_sequence(240) == ref
+            assert st.finish_reason == "max_tokens"
+
+    def test_cursor_at_max_len_writes_trash_and_stays_finite(self):
+        """Direct step call with a cursor AT max_len (an inactive lane
+        a horizon chunk can carry): the K/V write lands on the trash
+        page — every real page is untouched — and the embedding lookup
+        clamps instead of reading out of bounds."""
+        p = _params()
+        pool = init_paged_pool(CFG, n_pages=8, page_size=8)
+        table = jnp.arange(8, dtype=jnp.int32)[None, :]  # all real pages
+        logits, new_pool = paged_decode_step(
+            p, jnp.asarray([3], jnp.int32), pool, table,
+            jnp.asarray([CFG.max_len], jnp.int32),
+            jnp.asarray([False]), CFG)
+        assert bool(jnp.isfinite(logits).all())
+        for old, new in zip(pool.layers, new_pool.layers):
+            # real pages bit-unchanged; only the trash page absorbed it
+            assert bool((old["k"][:8] == new["k"][:8]).all())
+            assert bool((old["v"][:8] == new["v"][:8]).all())
+
+
 # ------------------------------------------------- concurrent clients
 class TestConcurrentSubmitters:
     def test_many_threads_submitting_concurrently(self):

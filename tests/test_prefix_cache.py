@@ -1,0 +1,465 @@
+"""Cross-request prefix caching: CoW KV page sharing (ISSUE 12).
+
+The contracts under test (serving/prefix_cache.py, decode_loop.py,
+docs/SERVING.md "Prefix caching"):
+
+1. **Content-addressed reuse**: a prompt whose leading FULL page-aligned
+   chunks were prefilled by an earlier request maps those pool pages by
+   reference and prefills only the uncovered tail — `prefill_tokens`
+   grows by the tail, not the prompt. A fully-covered prompt skips
+   prefill entirely.
+2. **Bit-identical outputs**: cached-prefix generation equals the
+   cache-disabled run token-for-token (shared pages are read-only until
+   forked; the fork copies exact bytes).
+3. **Copy-on-write**: the decode cursor entering a shared page forks it
+   into a private page first; forked pages never seed the cache.
+4. **Refcount invariants**: pages in use + free list + cached-but-
+   unreferenced always sum to `n_pages` through every join/retire/
+   cancel interleaving — no double-free, no leak.
+5. **Pressure behavior**: allocation under pressure LRU-evicts only
+   unreferenced cached pages; a fork that cannot get a page stalls the
+   slot (backpressure), never corrupts a shared page.
+6. **Wiring**: per-request opt-out (`prefix_cache: false`), /stats
+   cache section, `dl4j_kv_prefix_*` on a live /metrics scrape.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   init_transformer_params)
+from deeplearning4j_tpu.serving import InferenceEngine, serve_network
+from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
+from deeplearning4j_tpu.serving.kv_cache import generate_cached
+from deeplearning4j_tpu.serving.prefix_cache import PrefixIndex
+
+CFG = TransformerConfig(vocab_size=17, d_model=32, n_heads=2, n_layers=2,
+                        d_ff=64, max_len=64, interpret=True)
+
+
+def _params(seed=0):
+    return init_transformer_params(jax.random.PRNGKey(seed), CFG)
+
+
+def _prompt(rng, t):
+    return rng.randint(0, CFG.vocab_size, (t,)).astype(np.int32)
+
+
+def _ref_tokens(p, prompt, n):
+    """Greedy reference via the contiguous compiled-scan path."""
+    return np.asarray(generate_cached(
+        p, jnp.asarray(np.asarray(prompt)[None]), CFG, n))[0].tolist()
+
+
+def _assert_balance(loop):
+    """The three-way page invariant: every pool page is in exactly one
+    of in-use (refcount > 0), the free list, or the cached-unreferenced
+    tier."""
+    in_use = loop.pages_in_use
+    free = len(loop._free)
+    cached_unref = loop._cached_unref()
+    assert in_use + free + cached_unref == loop.n_pages, (
+        in_use, free, cached_unref, loop.n_pages)
+    # a page is never on the free list while referenced or cache-owned
+    for page in loop._free:
+        assert loop._ref[page] == 0
+        assert loop._prefix is None or not loop._prefix.owns(page)
+
+
+# ------------------------------------------------------ index unit tests
+class TestPrefixIndex:
+    def test_match_full_chunks_only(self):
+        idx = PrefixIndex(page_size=4)
+        idx.insert([1, 2, 3, 4, 5, 6, 7, 8], [10, 11])
+        assert idx.match([1, 2, 3, 4, 5, 6, 7, 8]) == [10, 11]
+        # 6 tokens cover one full chunk + a partial second: partial
+        # chunks never match
+        assert idx.match([1, 2, 3, 4, 5, 6]) == [10]
+        assert idx.match([1, 2, 3, 4, 9, 9, 9, 9]) == [10]
+        assert idx.match([9, 2, 3, 4]) == []
+        assert idx.match([1, 2, 3]) == []
+        assert len(idx) == 2
+
+    def test_insert_keeps_existing_pages(self):
+        idx = PrefixIndex(page_size=2)
+        assert idx.insert([1, 2, 3, 4], [7, 8]) == 2
+        # same chunks from another retiree: nothing adopted, original
+        # pages stay authoritative
+        assert idx.insert([1, 2, 3, 4], [20, 21]) == 0
+        assert idx.match([1, 2, 3, 4]) == [7, 8]
+        # divergent second chunk branches the trie
+        assert idx.insert([1, 2, 9, 9], [7, 30]) == 1
+        assert idx.match([1, 2, 9, 9]) == [7, 30]
+        assert len(idx) == 3
+
+    def test_insert_skip_stops_the_walk(self):
+        idx = PrefixIndex(page_size=2)
+        # page 8 was CoW-forked (diverged bytes): neither it NOR later
+        # chunks may seed — a gap would corrupt the path invariant
+        assert idx.insert([1, 2, 3, 4, 5, 6], [7, 8, 9], skip={8}) == 1
+        assert idx.match([1, 2, 3, 4]) == [7]
+        assert not idx.owns(8) and not idx.owns(9)
+
+    def test_evict_lru_leaf_only(self):
+        idx = PrefixIndex(page_size=2)
+        idx.insert([1, 2, 3, 4], [7, 8])
+        idx.insert([5, 6], [9])
+        idx.match([5, 6])  # freshen the [5,6] root
+        # page 7 is an interior node (has child 8): only leaves go.
+        # LRU among leaves {8, 9} is 8 (its path untouched since insert)
+        assert idx.evict_lru(lambda p: True) == 8
+        assert idx.evict_lru(lambda p: True) == 7  # now a leaf
+        assert idx.evict_lru(lambda p: p != 9) is None  # predicate veto
+        assert idx.evict_lru(lambda p: True) == 9
+        assert len(idx) == 0 and idx.match([1, 2]) == []
+
+    def test_validates_page_size(self):
+        with pytest.raises(ValueError, match="page_size"):
+            PrefixIndex(0)
+
+
+# --------------------------------------------------- loop-level sharing
+class TestPrefixSharing:
+    def test_warm_tail_prefills_only_uncovered_tokens(self):
+        """A resubmit sharing 2 prompt pages prefills 4 tail tokens
+        instead of 20, with output identical to the cache-disabled
+        loop's."""
+        p = _params()
+        rng = np.random.RandomState(0)
+        head = _prompt(rng, 16)                       # 2 full pages
+        long_pr = np.concatenate([head, _prompt(rng, 4)])
+        ref = _ref_tokens(p, long_pr, 6)
+        loop = DecodeLoop(p, CFG, slots=2, page_size=8, start=False)
+        try:
+            s1 = loop.submit(head, 2)                 # seeds the cache
+            loop.run_until_idle()
+            s1.result(5)
+            before = loop.snapshot()
+            assert before["prefix_cache"]["pages_cached"] == 2
+            s2 = loop.submit(long_pr, 6)
+            loop.run_until_idle()
+            assert s2.full_sequence(5) == ref         # bit-identical
+            snap = loop.snapshot()
+            assert snap["prefill_tokens"] - before["prefill_tokens"] == 4
+            assert snap["prefix_cache"]["hits"] == 1
+            _assert_balance(loop)
+        finally:
+            loop.close()
+
+    def test_full_hit_skips_prefill_and_forks_once(self):
+        """A fully-covered prompt runs NO prefill; its first decode
+        write re-enters the last shared page and CoW-forks it. Output
+        still equals the cold reference exactly."""
+        p = _params()
+        rng = np.random.RandomState(1)
+        pr = _prompt(rng, 16)
+        ref = _ref_tokens(p, pr, 5)
+        loop = DecodeLoop(p, CFG, slots=2, page_size=8, start=False)
+        try:
+            loop.submit(pr, 5)
+            loop.run_until_idle()
+            before = loop.snapshot()
+            s2 = loop.submit(pr, 5)
+            loop.run_until_idle()
+            assert s2.full_sequence(5) == ref
+            snap = loop.snapshot()
+            assert snap["prefill_tokens"] == before["prefill_tokens"]
+            assert snap["prefix_cache"]["forks"] == 1
+            assert snap["prefix_cache"]["hits"] == 1
+            assert snap["decode_step_programs"] == 1
+            _assert_balance(loop)
+        finally:
+            loop.close()
+
+    def test_forked_page_never_seeds_the_cache(self):
+        """After a full-hit fork retires, the cache still maps the
+        ORIGINAL page for the last chunk — the fork's bytes (which got
+        this request's decode writes) stay private and are freed."""
+        p = _params()
+        rng = np.random.RandomState(2)
+        pr = _prompt(rng, 16)
+        loop = DecodeLoop(p, CFG, slots=2, page_size=8, start=False)
+        try:
+            loop.submit(pr, 3)
+            loop.run_until_idle()
+            cached_before = sorted(loop._prefix.pages())
+            loop.submit(pr, 3)
+            loop.run_until_idle()
+            assert sorted(loop._prefix.pages()) == cached_before
+            _assert_balance(loop)
+        finally:
+            loop.close()
+
+    def test_concurrent_streams_share_one_prefix(self):
+        """Several in-flight requests over one cached prefix hold the
+        SAME physical pages (pages_shared reflects it), every stream
+        matches its solo reference, and the balance invariant holds on
+        every tick."""
+        p = _params()
+        rng = np.random.RandomState(3)
+        head = _prompt(rng, 16)
+        tails = [_prompt(rng, 4), _prompt(rng, 5), _prompt(rng, 6)]
+        prompts = [np.concatenate([head, t]) for t in tails]
+        refs = [_ref_tokens(p, pr, 6) for pr in prompts]
+        loop = DecodeLoop(p, CFG, slots=4, page_size=8, start=False)
+        try:
+            loop.submit(head, 2)
+            loop.run_until_idle()
+            streams = [loop.submit(pr, 6) for pr in prompts]
+            saw_shared = 0
+            for _ in range(200):
+                with loop._cond:
+                    if (not loop._waiting
+                            and loop.occupied_slots == 0):
+                        break
+                loop.tick()
+                _assert_balance(loop)
+                saw_shared = max(saw_shared, loop.pages_shared)
+            # the 2 head pages were mapped by >= 2 readers at once
+            assert saw_shared >= 2
+            for st, ref in zip(streams, refs):
+                assert st.full_sequence(5) == ref
+            assert loop.snapshot()["prefix_cache"]["hits"] == 3
+            _assert_balance(loop)
+        finally:
+            loop.close()
+
+    def test_lru_eviction_under_page_pressure(self):
+        """A pool full of cached pages serves new admissions by
+        evicting the least-recently-used unreferenced entries — the
+        cache never starves live traffic."""
+        p = _params()
+        rng = np.random.RandomState(4)
+        # pool of 4: two 16-token prompts fill it with 4 cached pages
+        loop = DecodeLoop(p, CFG, slots=2, page_size=8, n_pages=4,
+                          start=False)
+        try:
+            a, b = _prompt(rng, 16), _prompt(rng, 16)
+            loop.submit(a, 1)
+            loop.run_until_idle()
+            loop.submit(b, 1)
+            loop.run_until_idle()
+            assert loop.snapshot()["prefix_cache"]["pages_cached"] == 4
+            assert len(loop._free) == 0
+            # freshen a's path, then admit a cold prompt needing 2
+            # pages (15 prompt + 1 new = 16 tokens): both must come
+            # from b's stale entries
+            assert len(loop._prefix.match(list(a))) == 2
+            c = _prompt(rng, 15)
+            ref = _ref_tokens(p, c, 1)
+            st = loop.submit(c, 1)
+            loop.run_until_idle()
+            assert st.full_sequence(5) == ref
+            snap = loop.snapshot()["prefix_cache"]
+            assert snap["evictions"] == 2
+            assert len(loop._prefix.match(list(a))) == 2  # a survived
+            assert loop._prefix.match(list(b)) == []      # b evicted
+            _assert_balance(loop)
+        finally:
+            loop.close()
+
+    def test_fork_under_page_pressure_stalls_then_completes(self):
+        """A slot that must fork a shared page while the pool has
+        nothing to give STALLS (stop clamps at the shared frontier)
+        instead of corrupting the page, and resumes when a retirement
+        frees pages — output still exact."""
+        p = _params()
+        rng = np.random.RandomState(5)
+        pr = _prompt(rng, 16)
+        ref = _ref_tokens(p, pr, 4)
+        loop = DecodeLoop(p, CFG, slots=2, page_size=8, n_pages=5,
+                          start=False)
+        try:
+            loop.submit(pr, 1)            # seed: 2 cached pages, 3 free
+            loop.run_until_idle()
+            other = _prompt(rng, 8)
+            c = loop.submit(other, 17)    # grows to 3 pages over time
+            # run until C's decode cursor sits at length 16 — its NEXT
+            # grant takes the last free page
+            for _ in range(200):
+                loop.tick()
+                if int(loop._lengths[0]) >= 16:
+                    break
+            assert int(loop._lengths[0]) == 16 and len(loop._free) == 1
+            st = loop.submit(pr, 4)       # full hit: needs a fork page
+            waits_before = loop.snapshot()["admission_waits"]
+            loop.tick()  # C's grant wins the page; B's fork must stall
+            snap = loop.snapshot()
+            assert snap["prefix_cache"]["forks"] == 0
+            assert snap["admission_waits"] > waits_before
+            assert not st.done
+            _assert_balance(loop)
+            loop.run_until_idle()         # C retires -> B forks
+            assert c.result(5) is not None
+            assert st.full_sequence(5) == ref
+            snap = loop.snapshot()["prefix_cache"]
+            assert snap["forks"] == 1 and snap["evictions"] == 0
+            _assert_balance(loop)
+        finally:
+            loop.close()
+
+
+# ------------------------------------------------- opt-out + interleaves
+class TestOptOutAndInvariants:
+    def test_opt_out_neither_matches_nor_seeds(self):
+        p = _params()
+        rng = np.random.RandomState(6)
+        pr = _prompt(rng, 16)
+        loop = DecodeLoop(p, CFG, slots=2, page_size=8, start=False)
+        try:
+            loop.submit(pr, 2, prefix_cache=False)
+            loop.run_until_idle()
+            snap = loop.snapshot()["prefix_cache"]
+            assert snap["pages_cached"] == 0       # did not seed
+            assert snap["hits"] == 0 and snap["misses"] == 0
+            loop.submit(pr, 2)                     # seeds normally
+            loop.run_until_idle()
+            assert loop.snapshot()["prefix_cache"]["pages_cached"] == 2
+            before = loop.snapshot()["prefill_tokens"]
+            st = loop.submit(pr, 2, prefix_cache=False)
+            loop.run_until_idle()
+            st.result(5)
+            snap = loop.snapshot()
+            # full cold prefill despite the cache holding this prompt
+            assert snap["prefill_tokens"] - before == 16
+            assert snap["prefix_cache"]["hits"] == 0
+            _assert_balance(loop)
+        finally:
+            loop.close()
+
+    def test_disabled_loop_has_no_cache_overhead(self):
+        p = _params()
+        loop = DecodeLoop(p, CFG, slots=1, page_size=8,
+                          prefix_cache=False, start=False)
+        try:
+            loop.submit([1, 2, 3, 4, 5, 6, 7, 8], 2)
+            loop.run_until_idle()
+            snap = loop.snapshot()["prefix_cache"]
+            assert snap["enabled"] is False
+            assert snap["pages_cached"] == 0 and snap["nodes"] == 0
+            _assert_balance(loop)
+        finally:
+            loop.close()
+
+    def test_cancel_mid_share_releases_only_its_reference(self):
+        """Cancelling one of two streams reading a shared prefix keeps
+        the pages alive for the survivor; balance holds throughout."""
+        p = _params()
+        rng = np.random.RandomState(7)
+        head = _prompt(rng, 16)
+        pr1 = np.concatenate([head, _prompt(rng, 4)])
+        pr2 = np.concatenate([head, _prompt(rng, 5)])
+        ref2 = _ref_tokens(p, pr2, 8)
+        loop = DecodeLoop(p, CFG, slots=2, page_size=8, start=False)
+        try:
+            loop.submit(head, 1)
+            loop.run_until_idle()
+            s1 = loop.submit(pr1, 8)
+            s2 = loop.submit(pr2, 8)
+            loop.tick()                   # both admitted, sharing head
+            assert loop.pages_shared >= 2
+            s1.cancel()
+            loop.tick()                   # reap pass releases s1 only
+            assert s1.finish_reason == "cancelled"
+            _assert_balance(loop)
+            loop.run_until_idle()
+            assert s2.full_sequence(5) == ref2
+            _assert_balance(loop)
+        finally:
+            loop.close()
+
+    def test_threaded_submitters_one_prefix_balance_holds(self):
+        """Many threads hammering one shared prefix: every output
+        matches its solo reference and the pool balances at the end —
+        the admission/retire interleaving never double-frees or leaks."""
+        p = _params()
+        rng = np.random.RandomState(8)
+        head = _prompt(rng, 8)
+        prompts = [np.concatenate([head, _prompt(rng, 1 + i % 5)])
+                   for i in range(8)]
+        refs = [_ref_tokens(p, pr, 4) for pr in prompts]
+        outs: dict = {}
+        loop = DecodeLoop(p, CFG, slots=3, page_size=8, n_pages=12)
+        try:
+            def worker(k):
+                outs[k] = loop.submit(prompts[k], 4).full_sequence(240)
+
+            threads = [threading.Thread(target=worker, args=(k,))
+                       for k in range(len(prompts))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for k, ref in enumerate(refs):
+                assert outs[k] == ref
+            with loop._cond:
+                _assert_balance(loop)
+        finally:
+            loop.close()
+
+
+# ------------------------------------------------------------- HTTP e2e
+class TestPrefixCacheHTTP:
+    def test_stats_metrics_and_body_opt_out(self):
+        """/generate twice with one prompt: second is a cache hit;
+        `dl4j_kv_prefix_hits_total` appears on a live /metrics scrape,
+        /stats carries the cache section, and `"prefix_cache": false`
+        in the body opts a request out."""
+        from deeplearning4j_tpu.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (NeuralNetConfiguration.builder()
+                .lr(0.1).n_in(4).activation_function("tanh")
+                .optimization_algo("iteration_gradient_descent")
+                .num_iterations(1).use_adagrad(False)
+                .list(2).hidden_layer_sizes([8])
+                .override(1, layer="output", loss_function="mcxent",
+                          activation_function="softmax", n_out=3)
+                .pretrain(False).build())
+        gen = InferenceEngine.for_transformer(_params(), CFG)
+        prompt = [list(range(1, 17))]  # 2 full pages
+
+        def post(url, payload):
+            req = urllib.request.Request(
+                url, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read())
+
+        with serve_network(MultiLayerNetwork(conf), n_replicas=1,
+                           max_delay_ms=1.0, generate_engine=gen,
+                           slots=2, page_size=8) as handle:
+            cold = post(f"{handle.url}/generate",
+                        {"prompt": prompt, "max_tokens": 4})
+            warm = post(f"{handle.url}/generate",
+                        {"prompt": prompt, "max_tokens": 4})
+            assert warm["tokens"] == cold["tokens"]  # bit-identical
+            opted = post(f"{handle.url}/generate",
+                         {"prompt": prompt, "max_tokens": 4,
+                          "prefix_cache": False})
+            assert opted["tokens"] == cold["tokens"]
+            with urllib.request.urlopen(f"{handle.url}/stats",
+                                        timeout=30) as r:
+                stats = json.loads(r.read())
+            pc = stats["generate"]["decode"]["prefix_cache"]
+            assert pc["enabled"] is True
+            assert pc["hits"] == 1          # warm hit; opt-out did not
+            assert pc["pages_cached"] >= 2
+            with urllib.request.urlopen(f"{handle.url}/metrics",
+                                        timeout=30) as r:
+                text = r.read().decode()
+            for series in ("dl4j_kv_prefix_hits_total",
+                           "dl4j_kv_prefix_misses_total",
+                           "dl4j_kv_prefix_forks_total",
+                           "dl4j_kv_prefix_evictions_total",
+                           "dl4j_kv_pages_shared",
+                           "dl4j_kv_pages_cached"):
+                assert series in text, f"{series} missing from /metrics"
