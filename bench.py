@@ -597,6 +597,10 @@ def bench_serve():
     peak_paged_bytes = csnap["peak_pages_in_use"] * page_bytes
     ideal_pages = sum(pages_for_tokens(t + a, 16)
                       for t, a in zip(t0s, actuals))
+    # per-step KV traffic: the loop accounts BOTH lane figures every
+    # dispatch (streamed-kernel pages vs the dense gather window), so
+    # the reduction is visible whichever lane actually ran
+    ckv = csnap["decode_kernel"]["kv_read_bytes"]
     loop.close()
     decode_concurrent = {
         "tokens_per_sec_continuous": round(cont_rate, 2),
@@ -620,6 +624,13 @@ def bench_serve():
             "ideal_pages_for_written_tokens": ideal_pages,
             "paged_vs_contiguous":
                 round(peak_paged_bytes / contiguous_bytes, 4),
+        },
+        "kv_read_per_step": {
+            "path_selected": csnap["decode_kernel"]["selected"],
+            "kernel_bytes": ckv["kernel"],
+            "gather_bytes": ckv["gather"],
+            "reduction": (round(ckv["gather"] / ckv["kernel"], 2)
+                          if ckv["kernel"] else None),
         },
         "window_s": round(cont_win, 3),
         "per_request_window_s": round(seq_win, 3),
@@ -1895,6 +1906,123 @@ def bench_flash_bwd():
             "steps_per_window": steps, "window_s": round(win_s, 3)}
 
 
+def bench_paged_kernel():
+    """Paged-attention decode kernel config (docs/SERVING.md "Decode
+    kernel"). Two deterministic gates that hold on any platform: (a)
+    interpret-mode parity — the REAL Pallas kernel, run through the
+    interpreter, against the dense-gather path on the same evolving
+    pool, teacher-forced over ragged cursors including the max_len
+    window edge; (b) per-step KV read-bytes reduction — a chat-shaped
+    DecodeLoop drill whose dl4j_decode_kv_read_bytes counters give the
+    streamed-pages vs dense-window traffic exactly (ISSUE 13 gate:
+    >= 4x). The tokens/sec win itself is a TPU-lane number — interpret
+    timing is meaningless, so it is reported only when this config
+    compiled on a real chip."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.attention.paged_pallas import (
+        resolve_decode_kernel)
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig, init_transformer_params)
+    from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
+    from deeplearning4j_tpu.serving.paged_kv import (init_paged_pool,
+                                                     paged_decode_step,
+                                                     paged_prefill,
+                                                     pages_for_tokens,
+                                                     pages_per_slot)
+
+    fast = _fast()
+    cfg = TransformerConfig(vocab_size=512, d_model=64, n_heads=4,
+                            n_layers=2, d_ff=128, max_len=128,
+                            interpret=fast)
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+    ps = 16
+    rng = np.random.RandomState(0)
+
+    # ---- (a) kernel vs gather parity on one evolving pool: ragged
+    # prompts, teacher-forced steps crossing a page boundary, one slot
+    # pinned AT the window edge (cursor == max_len -> trash write)
+    P = pages_per_slot(cfg, ps)
+    n_pages = 4 * P
+    pool_g = init_paged_pool(cfg, n_pages, ps)
+    trash = pool_g.trash_page
+    t0s = [7, 16, 30, cfg.max_len]
+    table = np.full((4, P), trash, np.int32)
+    free = list(range(n_pages))
+    lengths = np.asarray(t0s, np.int32)
+    tb = 32
+    padded = np.zeros((4, tb), np.int32)
+    pids = np.full((4, tb // ps), trash, np.int32)
+    for i, t in enumerate(t0s):
+        pr = rng.randint(0, cfg.vocab_size, (min(t, tb),)).astype(np.int32)
+        padded[i, :len(pr)] = pr
+        need = pages_for_tokens(min(t, tb), ps)
+        pages = [free.pop(0) for _ in range(need)]
+        pids[i, :need] = pages
+        table[i, :need] = pages
+    # the window-edge slot owns its FULL reservation (all pages real)
+    table[3] = [free.pop(0) for _ in range(P)]
+    _, pool_g = paged_prefill(params, jnp.asarray(padded),
+                              jnp.asarray(np.minimum(lengths, tb)),
+                              pool_g, jnp.asarray(pids), cfg)
+    pool_p = pool_g
+    active = np.asarray([True, True, True, False])
+    max_err, steps = 0.0, 4
+    for _ in range(steps):
+        toks = rng.randint(0, cfg.vocab_size, (4,)).astype(np.int32)
+        for i in range(4):
+            if active[i]:
+                pidx = lengths[i] // ps
+                if table[i, pidx] == trash:
+                    table[i, pidx] = free.pop(0)
+        args = (jnp.asarray(toks), jnp.asarray(table),
+                jnp.asarray(lengths), jnp.asarray(active))
+        lg_g, pool_g = paged_decode_step(params, args[0], pool_g,
+                                         args[1], args[2], args[3],
+                                         cfg, kernel="gather")
+        lg_p, pool_p = paged_decode_step(params, args[0], pool_p,
+                                         args[1], args[2], args[3],
+                                         cfg, kernel="pallas")
+        max_err = max(max_err, float(jnp.max(jnp.abs(lg_p - lg_g))))
+        lengths = lengths + np.where(active, 1, 0).astype(np.int32)
+    if max_err > 1e-5:
+        raise AssertionError(
+            f"pallas vs gather decode max err {max_err}")
+
+    # ---- (b) chat-shaped KV traffic drill: short live contexts inside
+    # wide max_len reservations — exactly where the dense gather
+    # over-reads. The loop books BOTH lane figures every dispatch, so
+    # the gather lane (CPU smoke) measures the identical reduction the
+    # kernel lane realizes on-chip.
+    n_streams = 8
+    loop = DecodeLoop(params, cfg, slots=n_streams, page_size=ps,
+                      horizon=4)
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           (int(rng.choice([8, 16])),)).astype(np.int32)
+               for _ in range(n_streams)]
+    streams = [loop.submit(p, 16) for p in prompts]
+    for s in streams:
+        s.result(240)
+    snap = loop.snapshot()
+    loop.close()
+    kv = snap["decode_kernel"]["kv_read_bytes"]
+    reduction = kv["gather"] / kv["kernel"]
+    return {"value": round(reduction, 2), "unit": "x_kv_read_reduction",
+            "gate_4x": bool(reduction >= 4.0),
+            "parity_max_err": round(max_err, 9),
+            "parity_steps": steps,
+            "kernel_read_bytes": kv["kernel"],
+            "gather_read_bytes": kv["gather"],
+            "path_selected": snap["decode_kernel"]["selected"],
+            "auto_resolves_to": resolve_decode_kernel("auto", cfg, ps),
+            "interpret_parity": fast,
+            "tokens_per_sec": None if fast else "tpu_lane",
+            "compiled_on": jax.devices()[0].platform,
+            "n_streams": n_streams, "page_size": ps,
+            "pages_per_slot": pages_per_slot(cfg, ps)}
+
+
 CONFIGS = {
     "mlp": bench_mlp,
     "feed": bench_feed,
@@ -1913,6 +2041,7 @@ CONFIGS = {
     "glove": bench_glove,
     "flash": bench_flash,
     "flash_bwd": bench_flash_bwd,
+    "paged_kernel": bench_paged_kernel,
 }
 
 METRIC_NAMES = {
@@ -1933,6 +2062,7 @@ METRIC_NAMES = {
     "glove": "glove_training_triples_per_sec",
     "flash": "flash_attention_causal_step_time_ms",
     "flash_bwd": "flash_attention_grad_step_time_ms",
+    "paged_kernel": "serving_decode_kv_read_bytes_reduction",
 }
 
 

@@ -55,10 +55,19 @@ def _fit_tile(t: int, tile: int):
     Returns None when no such divisor exists (ragged t — caller falls
     back to blockwise). This keeps lengths like 768 or 1536 on the
     kernel with a smaller tile instead of silently demoting them to the
-    fallback when they don't divide the default tile."""
+    fallback when they don't divide the default tile.
+
+    Degenerate t == 1 (a decode-shaped single-row query) returns 1: the
+    tile dim is a Mosaic SUBLANE dim, which pads 1 -> 8 internally, so
+    a one-row tile is legal and costs one row of padding — not a full
+    q_tile of it, and not a demotion to the dense fallback. Other
+    sub-128 lengths still fall back (their padding story is unmeasured
+    and the prefill buckets never produce them on the kernel path)."""
     for c in range(tile - tile % 128, 0, -128):
         if c <= t and t % c == 0:
             return c
+    if t == 1:
+        return 1
     return None
 
 

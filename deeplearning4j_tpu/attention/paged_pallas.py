@@ -1,0 +1,197 @@
+"""Paged-attention decode as a Pallas TPU kernel.
+
+`paged_decode_step` (serving/paged_kv.py) historically gathered every
+slot's page list into a dense `(S, H, window, hd)` K/V window each
+step — per-step HBM traffic scaling with the page-table RESERVATION
+(`S × max_len`), not the tokens actually written. This kernel streams
+pages straight from the pool instead (the PagedAttention design,
+PAPERS.md arXiv:2603.09555, on the repo's kernel-with-interpret
+portability pattern from `attention/flash_pallas.py`):
+
+- grid `(S, P)`: one slot per row, one page-table column per step. The
+  page table and per-slot lengths ride `PrefetchScalarGridSpec` scalar
+  prefetch, so the K/V BlockSpec index map picks the PHYSICAL page
+  (`pt[s, j]`) for each grid step — the pool is the kernel operand and
+  no dense window is ever materialized;
+- online softmax across a slot's pages: f32 scratch (acc, m, s) carried
+  over the sequential page dimension, base-2 state (`exp2`, scores
+  prescaled by log2(e)/sqrt(hd)) exactly like the flash kernels;
+- pages past a slot's written frontier (`j * page_size > pos`) are
+  skipped with `pl.when` — no MXU work, and because unallocated page
+  table entries all hold the trash index, Pallas's pipeline skips even
+  the re-fetch (consecutive grid steps with identical block indices);
+- lanes past the cursor inside the frontier page are masked to NEG_INF
+  (underflow to exactly 0), matching the gather path's masked softmax,
+  so parity with `kernel="gather"` holds at 1e-5 (tests pin it under
+  ragged membership, CoW-shared pages, and the max_len window edge).
+
+`resolve_decode_kernel` is the lane selector behind the
+`kernel="pallas"|"gather"|"auto"` knob (`DecodeLoop`, `engine`,
+`cli serve`): `auto` takes the kernel only on TPU inside the calibrated
+envelope and NEVER silently runs interpret mode off-TPU (the
+`flash_pallas` group-gate precedent); explicit `pallas` off-TPU is an
+error unless `cfg.interpret` is set (the CPU tier-1 test lane).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.attention.flash_pallas import (LOG2E, NEG_INF,
+                                                       _tpu_compiler_params)
+
+__all__ = ["paged_attention", "resolve_decode_kernel", "DECODE_KERNELS"]
+
+DECODE_KERNELS = ("auto", "pallas", "gather")
+
+
+def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, s_ref, *, page_size: int):
+    """One (slot, page) grid step. `pt_ref`/`len_ref` are the
+    scalar-prefetch operands (the same arrays the BlockSpec index maps
+    read); K/V refs already hold the PHYSICAL page the index map
+    selected for this step."""
+    from jax.experimental import pallas as pl
+
+    si = pl.program_id(0)
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+    pos = len_ref[si]   # this slot's cursor: positions [0, pos] visible
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        s_ref[...] = jnp.zeros_like(s_ref)
+
+    # pages wholly past the written frontier contribute exactly 0 in the
+    # gather path (every lane masked): skip them here — page 0 always
+    # computes (pos >= 0), so the softmax sum is never empty
+    @pl.when(j * page_size <= pos)
+    def _tile():
+        q = q_ref[0]          # (H, hd)
+        k = k_ref[0]          # (H, ps, hd)
+        v = v_ref[0]
+        hd = q.shape[-1]
+        # base-2 softmax state, scores prescaled by log2(e)/sqrt(hd):
+        # the transcendental is a bare exp2 (flash_pallas._kernel)
+        scale2 = jnp.float32(LOG2E) / jnp.float32(hd) ** 0.5
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale2   # (H, ps)
+        k_pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        mask = k_pos <= pos   # current token at `pos` IS visible
+        scores = jnp.where(mask, scores, NEG_INF)
+        m_prev, s_prev = m_ref[...], s_ref[...]
+        m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
+        alpha = jnp.exp2(m_prev - m_new)
+        p = jnp.exp2(scores - m_new)
+        p = jnp.where(mask, p, 0.0)
+        m_ref[...] = m_new
+        s_ref[...] = s_prev * alpha + p.sum(axis=-1, keepdims=True)
+        # P in V's storage dtype for the MXU dot, f32 accumulation —
+        # same rounding story as the flash forward
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(s_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, page_table, lengths, *,
+                    interpret: bool = False):
+    """Single-token paged attention over the block pool.
+
+    q: (S, H, hd) — one decode query row per slot (the token being
+    written this step). k_pool/v_pool: (n_pages + 1, H, page_size, hd)
+    block pools, last page = trash. page_table: (S, P) int32 pool
+    indices (trash-filled past each slot's allocation). lengths: (S,)
+    int32 cursors — positions [0, lengths[s]] are attended (the
+    incoming token's K/V must already be scattered at its cursor,
+    exactly as `paged_decode_step` orders writes before attention).
+
+    Returns (S, H, hd) in q.dtype. page_table/lengths are traced
+    values: membership changes never recompile (the
+    `decode_step_programs() == 1` invariant)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s, h, hd = q.shape
+    ps = k_pool.shape[2]
+    n_j = page_table.shape[1]
+    kv_spec = pl.BlockSpec((1, h, ps, hd),
+                           lambda si, j, pt, ln: (pt[si, j], 0, 0, 0),
+                           memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, n_j),
+        in_specs=[
+            pl.BlockSpec((1, h, hd), lambda si, j, pt, ln: (si, 0, 0),
+                         memory_space=pltpu.VMEM),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=pl.BlockSpec((1, h, hd),
+                               lambda si, j, pt, ln: (si, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((h, hd), jnp.float32),   # acc
+            pltpu.VMEM((h, 1), jnp.float32),    # running max (base-2)
+            pltpu.VMEM((h, 1), jnp.float32),    # running sum
+        ])
+    return pl.pallas_call(
+        partial(_decode_kernel, page_size=ps),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, h, hd), q.dtype),
+        # slots are independent (scratch init/finalize is per-row);
+        # only the page sweep carries the online-softmax state
+        compiler_params=_tpu_compiler_params(
+            pltpu, dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pool, v_pool)
+
+
+def resolve_decode_kernel(kernel: str, cfg, page_size: int) -> str:
+    """Resolve the `kernel="pallas"|"gather"|"auto"` knob to the lane
+    `paged_decode_step` actually runs — ONCE, at loop construction, so
+    the decode step stays one compiled program.
+
+    - "gather": always the dense-gather path.
+    - "pallas": the kernel; off-TPU this raises unless `cfg.interpret`
+      is set (tests run the kernel code path through the interpreter —
+      production must never fall into that silently).
+    - "auto": the kernel on TPU inside the calibrated envelope
+      (hd <= 128, <= 4-byte KV dtype, page_size >= 8 — lanes/sublane
+      padding stays bounded); everything else takes the gather path.
+      Off-TPU auto is ALWAYS gather, interpret or not: interpret mode
+      is a test lane, not a production fallback."""
+    if kernel not in DECODE_KERNELS:
+        raise ValueError(
+            f"kernel must be one of {DECODE_KERNELS}, got {kernel!r}")
+    on_tpu = jax.default_backend() == "tpu"
+    if kernel == "gather":
+        return "gather"
+    if kernel == "pallas":
+        if not on_tpu and not getattr(cfg, "interpret", False):
+            raise ValueError(
+                "kernel='pallas' needs a TPU backend; off-TPU the "
+                "kernel only runs under interpret mode (set "
+                "cfg.interpret=True in tests) — use kernel='gather' "
+                "or 'auto' instead")
+        return "pallas"
+    # auto
+    if not on_tpu:
+        return "gather"
+    hd = cfg.d_model // cfg.n_heads
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    if hd > 128 or itemsize > 4 or page_size < 8:
+        return "gather"
+    return "pallas"

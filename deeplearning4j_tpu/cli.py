@@ -387,6 +387,7 @@ def cmd_serve(args) -> int:
             max_queue=args.max_queue,
             slots=args.slots, page_size=args.page_size,
             prefix_cache=args.prefix_cache,
+            decode_kernel=args.decode_kernel,
             warmup_shape=(n_in,) if (args.warmup and n_in) else None,
             warmup_async=args.warmup_async)
     except BaseException:
@@ -399,6 +400,7 @@ def cmd_serve(args) -> int:
                       "slots": args.slots,
                       "page_size": args.page_size,
                       "prefix_cache": args.prefix_cache,
+                      "decode_kernel": args.decode_kernel,
                       "metrics": handle.url + "/metrics",
                       **tele.announce()}), flush=True)
     if args.smoke:  # start/stop sanity check (tests, deploy probes)
@@ -784,6 +786,13 @@ def build_parser() -> argparse.ArgumentParser:
                          help="cross-request KV prefix sharing in the "
                               "decode pool (--no-prefix-cache disables; "
                               "docs/SERVING.md)")
+    p_serve.add_argument("--decode-kernel", default="auto",
+                         choices=("auto", "pallas", "gather"),
+                         help="decode attention lane: pallas streams "
+                              "written KV pages from the pool (TPU), "
+                              "gather materializes the dense window; "
+                              "auto picks pallas on TPU inside its "
+                              "envelope (docs/SERVING.md)")
     p_serve.add_argument("--no-warmup", dest="warmup",
                          action="store_false",
                          help="skip precompiling the bucket programs")

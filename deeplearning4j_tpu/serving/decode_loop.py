@@ -73,12 +73,25 @@ positions (tests pin whole-output equality). Per-request opt-out:
 `submit*(..., prefix_cache=False)` neither matches nor seeds the cache
 (secret-bearing prompts must not leak into shared pages).
 
+**Decode kernel** (`kernel="auto"|"pallas"|"gather"`): the attention
+read inside the compiled step. "pallas" streams each slot's WRITTEN
+pages straight from the pool (`attention/paged_pallas.py` — per-step
+KV traffic O(written pages)); "gather" materializes the dense
+`S × max_len` window (the legacy path, O(reservation)). "auto"
+resolves ONCE at construction — the kernel on TPU inside its
+calibrated envelope, gather everywhere else (never a silent
+interpret-mode slowdown off-TPU) — so the step stays one compiled
+program either way. Both figures are exported every dispatch as
+dl4j_decode_kv_read_bytes{path="kernel"|"gather"} so the traffic win
+is visible whichever lane runs.
+
 Telemetry: dl4j_kv_pages_total / dl4j_kv_pages_in_use /
 dl4j_kv_pages_shared / dl4j_kv_pages_cached /
 dl4j_decode_active_slots gauges, dl4j_decode_requests /
 dl4j_decode_tokens_streamed / dl4j_decode_admission_waits /
-dl4j_kv_prefix_{hits,misses,forks,evictions} counters
-(docs/OBSERVABILITY.md).
+dl4j_kv_prefix_{hits,misses,forks,evictions} /
+dl4j_decode_kv_read_bytes{path} counters, dl4j_decode_step_seconds
+histogram (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -86,6 +99,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import time
 import weakref
 from collections import deque
 from typing import Iterator, List, Optional, Sequence
@@ -93,11 +107,13 @@ from typing import Iterator, List, Optional, Sequence
 import numpy as np
 
 from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.attention.paged_pallas import resolve_decode_kernel
 from deeplearning4j_tpu.models.transformer import TransformerConfig
 from deeplearning4j_tpu.serving.errors import (Deadline,
                                                DeadlineExceededError,
                                                OverloadedError)
 from deeplearning4j_tpu.serving.paged_kv import (copy_page,
+                                                 decode_read_bytes,
                                                  init_paged_pool,
                                                  paged_decode_step,
                                                  paged_kv_bytes,
@@ -243,7 +259,7 @@ class DecodeLoop:
     def __init__(self, params, cfg: TransformerConfig, *, slots: int = 8,
                  page_size: int = 16, n_pages: Optional[int] = None,
                  horizon: int = 1, max_waiting: Optional[int] = None,
-                 prefix_cache: bool = True,
+                 prefix_cache: bool = True, kernel: str = "auto",
                  start: bool = True, name: Optional[str] = None):
         import jax
         import jax.numpy as jnp
@@ -260,6 +276,11 @@ class DecodeLoop:
         self.slots = int(slots)
         self.page_size = int(page_size)
         self.horizon = int(horizon)
+        # resolve "auto" ONCE, before jitting: the lane is a
+        # compile-time constant of the single step program
+        self.kernel_requested = kernel
+        self.decode_kernel = resolve_decode_kernel(
+            kernel, cfg, self.page_size)
         self._pps = pages_per_slot(cfg, self.page_size)
         if n_pages is None:
             # safe default: worst case (every slot at max_len) — callers
@@ -318,7 +339,8 @@ class DecodeLoop:
                 tokens, lengths, pool = carry
                 act = lengths < stop
                 logits, pool = paged_decode_step(
-                    params, tokens, pool, table, lengths, act, cfg)
+                    params, tokens, pool, table, lengths, act, cfg,
+                    kernel=self.decode_kernel)
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 tokens = jnp.where(act, nxt, tokens)
                 lengths = lengths + act.astype(lengths.dtype)
@@ -406,6 +428,22 @@ class DecodeLoop:
             "dl4j_kv_prefix_evictions",
             "unreferenced cached prefix pages evicted (LRU) to satisfy "
             "an allocation under page pressure").labels(**lab)
+        _kv_read = reg.counter(
+            "dl4j_decode_kv_read_bytes",
+            "KV bytes the decode attention read must touch, summed "
+            "over token steps: path=\"kernel\" is the streamed-pages "
+            "figure (written pages only — what the pallas lane reads), "
+            "path=\"gather\" the dense-window figure (the full "
+            "S x max_len reservation); their ratio is the paged "
+            "kernel's traffic win")
+        self._m_kv_read = {
+            path: _kv_read.labels(path=path, **lab)
+            for path in ("kernel", "gather")}
+        self._m_step_s = reg.histogram(
+            "dl4j_decode_step_seconds",
+            "wall time of one compiled decode dispatch (covers "
+            "`horizon` token steps), dispatch through the token D2H "
+            "sync").labels(**lab)
         reg.gauge(
             "dl4j_kv_pages_total",
             "usable KV pages in the block pool").labels(**lab).set(
@@ -654,6 +692,14 @@ class DecodeLoop:
                 "cancelled": int(self._m_cancelled.value),
                 "admission_waits": int(self._m_waits.value),
                 "dispatches": int(self._m_steps.value),
+                "decode_kernel": {
+                    "requested": self.kernel_requested,
+                    "selected": self.decode_kernel,
+                    "kv_read_bytes": {
+                        "kernel": int(self._m_kv_read["kernel"].value),
+                        "gather": int(self._m_kv_read["gather"].value),
+                    },
+                },
                 "decode_step_programs": self.decode_step_programs(),
                 "prefill_programs": self.prefill_programs(),
                 "prefill_ctx_programs": jit_cache_size(self._prefill_ctx),
@@ -1064,13 +1110,29 @@ class DecodeLoop:
                 rows = jnp.asarray([r for r, _ in members])
                 idxs = jnp.asarray([i for _, i in members])
                 self._d_tokens = self._d_tokens.at[idxs].set(arr[rows])
+        t0 = time.perf_counter()
         toks, t_out, l_out, self._pool = self._step(
             self.params, self._d_tokens, self._pool, self._d_table,
             self._d_lengths, self._d_stop)
         self._m_steps.inc()
         # the (K, S) token D2H is the sync the streams need anyway
         toks = np.asarray(toks)
+        self._m_step_s.observe(time.perf_counter() - t0)
         self._d_tokens, self._d_lengths = t_out, l_out
+        # per-token-step KV read accounting, host math mirroring the
+        # device chain: inner step k runs at cursor before+k, clamped
+        # at each slot's stop bound (stalled/idle slots hold still).
+        # Both figures are recorded each dispatch — the selected lane
+        # is in snapshot()["decode_kernel"]
+        advance = np.maximum(self._stop - before, 0)
+        ideal = dense = 0
+        for k in range(self.horizon):
+            cur = before + np.minimum(k, advance)
+            ideal += decode_read_bytes(self._pool, cur, self._pps)
+            dense += decode_read_bytes(self._pool, cur, self._pps,
+                                       dense=True)
+        self._m_kv_read["kernel"].inc(ideal)
+        self._m_kv_read["gather"].inc(dense)
         self._flush_first_tokens()  # emit firsts BEFORE chunk tokens
         for i in runnable:
             slot = self._slot_state[i]

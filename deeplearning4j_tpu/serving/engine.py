@@ -192,14 +192,17 @@ class InferenceEngine:
                         kv_pages: Optional[int] = None,
                         max_waiting: Optional[int] = None,
                         prefix_cache: bool = True,
+                        decode_kernel: str = "auto",
                         **kw) -> "InferenceEngine":
         """Wrap a transformer LM: apply = full logits (B, T, vocab);
         `generate()` runs the per-request KV-cached compiled scan.
         `decode_slots > 0` additionally starts the continuous-batching
         `DecodeLoop` (paged KV pool, `generate_stream()`); pass
         `page_size`/`kv_pages` to size the pool, `max_waiting` to
-        bound its admission queue, and `prefix_cache=False` to disable
-        cross-request KV prefix sharing (docs/SERVING.md)."""
+        bound its admission queue, `prefix_cache=False` to disable
+        cross-request KV prefix sharing, and `decode_kernel` to pick
+        the decode attention lane ("auto" = the Pallas paged kernel on
+        TPU, dense gather elsewhere — docs/SERVING.md)."""
         from deeplearning4j_tpu.models.transformer import transformer_logits
         from deeplearning4j_tpu.serving.kv_cache import generate_cached
 
@@ -212,7 +215,8 @@ class InferenceEngine:
             eng.start_decode_loop(slots=decode_slots, page_size=page_size,
                                   n_pages=kv_pages,
                                   max_waiting=max_waiting,
-                                  prefix_cache=prefix_cache)
+                                  prefix_cache=prefix_cache,
+                                  kernel=decode_kernel)
         return eng
 
     @classmethod
@@ -286,13 +290,15 @@ class InferenceEngine:
                           n_pages: Optional[int] = None,
                           horizon: int = 1,
                           max_waiting: Optional[int] = None,
-                          prefix_cache: bool = True):
+                          prefix_cache: bool = True,
+                          kernel: str = "auto"):
         """Start the continuous-batching slot scheduler
         (serving/decode_loop.py) for this transformer engine: S slots
         over a paged KV pool riding ONE compiled decode step. `/generate`
         traffic routes here instead of the per-request compiled-scan
         path — requests join/leave at token boundaries and KV memory
-        scales with written tokens."""
+        scales with written tokens. `kernel` picks the decode attention
+        lane ("auto"|"pallas"|"gather", docs/SERVING.md)."""
         from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
 
         if self._tf_cfg is None:
@@ -305,7 +311,8 @@ class InferenceEngine:
                                       slots=slots, page_size=page_size,
                                       n_pages=n_pages, horizon=horizon,
                                       max_waiting=max_waiting,
-                                      prefix_cache=prefix_cache)
+                                      prefix_cache=prefix_cache,
+                                      kernel=kernel)
         return self.decode_loop
 
     def generate_stream(self, prompt, max_tokens: int,

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 
 from deeplearning4j_tpu.attention.blockwise import NEG_INF
 from deeplearning4j_tpu.attention.flash_pallas import flash_attention
+from deeplearning4j_tpu.attention.paged_pallas import paged_attention
 from deeplearning4j_tpu.models.transformer import (TransformerConfig,
                                                    _layer_norm)
 from deeplearning4j_tpu.serving.kv_cache import _ffn, _heads
@@ -52,7 +53,7 @@ from deeplearning4j_tpu.serving.kv_cache import _ffn, _heads
 __all__ = ["PagedKVPool", "init_paged_pool", "paged_kv_bytes",
            "pages_per_slot", "pages_for_tokens", "prompt_buckets",
            "paged_prefill", "paged_prefill_ctx", "paged_decode_step",
-           "copy_page"]
+           "copy_page", "decode_read_bytes"]
 
 
 class PagedKVPool(NamedTuple):
@@ -279,11 +280,37 @@ def paged_prefill_ctx(params, tokens, true_len, pool: PagedKVPool,
     return last_x @ params["embed"].T, PagedKVPool(tuple(new_layers))
 
 
+def decode_read_bytes(pool: PagedKVPool, lengths, table_width: int, *,
+                      dense: bool = False) -> int:
+    """Host-side accounting: KV bytes ONE decode token step must read
+    for attention, summed over slots. Default (`dense=False`) is the
+    streamed-kernel figure — K+V for each slot's written pages only,
+    `min(floor(pos / page_size) + 1, table_width)` pages at cursor
+    `pos` (exactly the pages `paged_attention`'s grid computes, the
+    trash-page read of an idle slot included). `dense=True` is the
+    dense-gather figure: every slot touches its FULL page-table
+    reservation (`S × table_width` pages) regardless of how little was
+    written. The ratio of the two is the kernel's traffic win, exported
+    per dispatch as dl4j_decode_kv_read_bytes{path="kernel"|"gather"}
+    (decode_loop; docs/OBSERVABILITY.md)."""
+    layer = pool.layers[0]["k"]
+    ps = pool.page_size
+    page_bytes = (layer.shape[1] * ps * layer.shape[3]
+                  * jnp.dtype(layer.dtype).itemsize)
+    if dense:
+        pages = len(lengths) * int(table_width)
+    else:
+        pages = sum(min(int(pos) // ps + 1, int(table_width))
+                    for pos in lengths)
+    return 2 * len(pool.layers) * page_bytes * int(pages)
+
+
 def paged_decode_step(params, tokens, pool: PagedKVPool, page_table,
-                      lengths, active, cfg: TransformerConfig):
+                      lengths, active, cfg: TransformerConfig,
+                      kernel: str = "gather"):
     """One decode step over S slots: embed `tokens` (S,), write each
     active slot's K/V at its own cursor (`lengths`) through the page
-    table, attend over the slot's gathered pages, return
+    table, attend over the slot's pages, return
     (logits (S, vocab), updated pool).
 
     Everything ragged is a traced ARRAY, never a shape: page_table
@@ -291,7 +318,20 @@ def paged_decode_step(params, tokens, pool: PagedKVPool, page_table,
     join and leave at token boundaries under ONE compiled program for
     the life of the server. Inactive slots write to the trash page and
     their logits are garbage the host ignores; lengths advance on the
-    host side only for slots that ran."""
+    host side only for slots that ran.
+
+    `kernel` picks the attention read: "gather" materializes each
+    slot's dense `(S, H, window, hd)` K/V window (O(S × max_len) HBM
+    traffic per step); "pallas" streams only the written pages from the
+    pool through `attention.paged_pallas.paged_attention` (same masked
+    softmax to 1e-5; `cfg.interpret` runs it on CPU). Callers resolve
+    "auto" BEFORE jitting with `resolve_decode_kernel` — the knob is a
+    compile-time constant, not a traced value."""
+    if kernel not in ("gather", "pallas"):
+        raise ValueError(
+            f"kernel must be 'gather' or 'pallas' here (resolve 'auto' "
+            f"via attention.paged_pallas.resolve_decode_kernel), "
+            f"got {kernel!r}")
     s = tokens.shape[0]
     d = cfg.d_model
     hd = d // cfg.n_heads
@@ -325,21 +365,30 @@ def paged_decode_step(params, tokens, pool: PagedKVPool, page_table,
             k_new.astype(layer["k"].dtype))
         vs = layer["v"].at[dest, :, offset, :].set(
             v_new.astype(layer["v"].dtype))
-        # gather each slot's pages into its logical window:
-        # (S, P, H, ps, hd) -> (S, H, P*ps, hd)
-        kg = ks[page_table].transpose(0, 2, 1, 3, 4).reshape(
-            s, cfg.n_heads, window, hd)
-        vg = vs[page_table].transpose(0, 2, 1, 3, 4).reshape(
-            s, cfg.n_heads, window, hd)
-        # exact masked softmax in f32 (the contiguous decode_step math;
-        # masked lanes underflow to exactly 0, so page-tail garbage
-        # contributes exactly 0)
-        sc = jnp.einsum("shqd,shkd->shqk", q.astype(jnp.float32),
-                        kg.astype(jnp.float32)) * scale
-        sc = jnp.where(mask[:, None, None, :], sc, NEG_INF)
-        w = jax.nn.softmax(sc, axis=-1)
-        att = jnp.einsum("shqk,shkd->shqd", w, vg.astype(jnp.float32))
-        att = att.astype(x.dtype).transpose(0, 2, 1, 3).reshape(s, 1, d)
+        if kernel == "pallas":
+            # stream the written pages straight from the pool — no
+            # dense window; masking/trash/window-edge handled in-kernel
+            att = paged_attention(q[:, :, 0, :], ks, vs, page_table,
+                                  lengths, interpret=cfg.interpret)
+            att = att.astype(x.dtype).reshape(s, 1, d)
+        else:
+            # gather each slot's pages into its logical window:
+            # (S, P, H, ps, hd) -> (S, H, P*ps, hd)
+            kg = ks[page_table].transpose(0, 2, 1, 3, 4).reshape(
+                s, cfg.n_heads, window, hd)
+            vg = vs[page_table].transpose(0, 2, 1, 3, 4).reshape(
+                s, cfg.n_heads, window, hd)
+            # exact masked softmax in f32 (the contiguous decode_step
+            # math; masked lanes underflow to exactly 0, so page-tail
+            # garbage contributes exactly 0)
+            sc = jnp.einsum("shqd,shkd->shqk", q.astype(jnp.float32),
+                            kg.astype(jnp.float32)) * scale
+            sc = jnp.where(mask[:, None, None, :], sc, NEG_INF)
+            w = jax.nn.softmax(sc, axis=-1)
+            att = jnp.einsum("shqk,shkd->shqd", w,
+                             vg.astype(jnp.float32))
+            att = att.astype(x.dtype).transpose(0, 2, 1, 3).reshape(
+                s, 1, d)
         x = x + att @ p["Wo"]
         x = _ffn(p, x)
         new_layers.append({"k": ks, "v": vs})

@@ -212,6 +212,7 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                   kv_pages: Optional[int] = None,
                   max_waiting: Optional[int] = None,
                   prefix_cache: bool = True,
+                  decode_kernel: str = "auto",
                   host: str = "127.0.0.1", port: int = 0,
                   warmup_shape=None,
                   warmup_async: bool = False) -> ServingHandle:
@@ -234,7 +235,9 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
     either, requests shed with 503 + Retry-After. `prefix_cache=False`
     disables cross-request KV prefix sharing in the decode loop;
     individual requests opt out with `"prefix_cache": false` in the
-    /generate body.
+    /generate body. `decode_kernel` picks the decode attention lane
+    ("auto" = Pallas paged kernel on TPU, dense gather elsewhere;
+    docs/SERVING.md "Decode kernel").
     """
     if replicas is None:
         if net is None:
@@ -251,7 +254,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
         generate_engine.start_decode_loop(slots=slots, page_size=page_size,
                                           n_pages=kv_pages,
                                           max_waiting=max_waiting,
-                                          prefix_cache=prefix_cache)
+                                          prefix_cache=prefix_cache,
+                                          kernel=decode_kernel)
     batcher = replicas.batcher(max_batch_size=max_batch_size,
                                max_delay_ms=max_delay_ms,
                                max_queue=max_queue)

@@ -56,6 +56,12 @@ def pytest_configure(config):
         "supervisor); fast seeded-chaos drills run in tier-1, the "
         "SIGKILL/SIGSTOP process soaks also carry @slow — run the "
         "whole layer with pytest -m elastic")
+    config.addinivalue_line(
+        "markers",
+        "pallas: Pallas kernel lane (flash + paged decode); tier-1 "
+        "runs these through the interpreter on CPU, the same kernel "
+        "code compiles on TPU — run just this layer with "
+        "pytest -m pallas")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -218,8 +218,20 @@ class TestHTTPRoundTrip:
                     "dl4j_decode_active_slots",
                     "dl4j_decode_tokens_streamed_total",
                     "dl4j_decode_requests_total",
+                    "dl4j_decode_kv_read_bytes_total",
+                    "dl4j_decode_step_seconds",
             ):
                 assert series in text, f"{series} missing from /metrics"
+            # the KV traffic counters carry both lane figures — the
+            # streamed-kernel figure must undercut the dense one
+            kv_read = {}
+            for ln in text.splitlines():
+                if ln.startswith("dl4j_decode_kv_read_bytes_total{"):
+                    for path in ("kernel", "gather"):
+                        if f'path="{path}"' in ln:
+                            kv_read[path] = float(ln.split()[-1])
+            assert kv_read.get("kernel", 0) > 0
+            assert kv_read["gather"] > kv_read["kernel"]
             # the pool gauge reports this loop's configured size and
             # the request actually streamed its tokens
             label = gen.decode_loop.label
