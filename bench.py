@@ -1662,6 +1662,395 @@ def bench_controlplane():
     }
 
 
+def bench_pipeline():
+    """Train→serve conveyor drill (ISSUE 14, docs/PIPELINE.md): one
+    model continuously training AND continuously serving its newest
+    good weights, with every process in the chain kill -9'd mid-flight
+    under a client request hammer.
+
+    Topology (all real processes): `cli watchdog -- train --elastic 2
+    --checkpoint-dir ck` commits sharded steps; `cli fleet --replicas 2`
+    serves them behind the router; `cli watchdog -- pipeline` watches
+    ck, eval-gates each COMMITTED step on a held-out set, and canary-
+    promotes through POST /reload. The drill kills, in order: the
+    elastic SUPERVISOR (watchdog restarts it, elastic resume), the
+    deployment CONTROLLER (watchdog restarts it, journal resume), one
+    REPLICA (fleet evicts it, retries mask the hammer), and the ROUTER
+    (the bench relaunches it on the same port; the journal re-adopts
+    the surviving replica warm). Then a poisoned checkpoint (random
+    weights → eval-fail → quarantine) and an arch-mismatched one
+    (canary reload failure → rollback + quarantine) ride the conveyor.
+
+    Gates: zero hammer errors outside the kill→readmission windows; no
+    torn promotion — the router's checkpoint-identity /stats shows every
+    serving replica on EXACTLY one champion; the fleet converges to the
+    newest eval-passed COMMITTED step; both poison steps carry
+    QUARANTINED markers; dl4j_pipeline_{promotions,rollbacks,
+    quarantines} scraped live from the controller's /metrics. Value:
+    seconds from the training run's last commit to the fleet serving
+    that step (the conveyor's end-to-end latency).
+    """
+    import signal
+    import socket
+    import tempfile
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu.checkpoint import ShardedModelSaver
+    from deeplearning4j_tpu.checkpoint.restore import list_committed_steps
+    from deeplearning4j_tpu.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.deploy import QUARANTINE_MARKER
+    from deeplearning4j_tpu.checkpoint import format as ckfmt
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.testing import chaos as chaos_mod
+    import sys as _sys
+
+    py = _sys.executable
+    work = tempfile.mkdtemp(prefix="dl4j_bench_pipe_")
+
+    # separable 3-class clusters: the gate spread between a fit net
+    # (~1.0 f1) and a random-init poison (~0.33) is wide and reliable
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 3, 240)
+    feats = (np.eye(3, 4, dtype=np.float32)[labels] * 4.0
+             + 0.3 * rng.randn(240, 4)).astype(np.float32)
+    train_csv = os.path.join(work, "train.csv")
+    np.savetxt(train_csv, np.hstack([feats[:192], labels[:192, None]]),
+               delimiter=",", fmt="%.6f")
+    holdout_csv = os.path.join(work, "holdout.csv")
+    np.savetxt(holdout_csv, np.hstack([feats[192:],
+                                       labels[192:, None]]),
+               delimiter=",", fmt="%.6f")
+
+    def build_conf(hidden=8):
+        return (NeuralNetConfiguration.builder()
+                .lr(0.1).n_in(4).activation_function("tanh")
+                .optimization_algo("iteration_gradient_descent")
+                .num_iterations(1).use_adagrad(False)
+                .list(2).hidden_layer_sizes([hidden])
+                .override(1, layer="output", loss_function="mcxent",
+                          activation_function="softmax", n_out=3)
+                .pretrain(False).build())
+
+    conf_path = os.path.join(work, "conf.json")
+    with open(conf_path, "w") as f:
+        f.write(build_conf().to_json())
+    boot_dir = os.path.join(work, "boot")
+    with ShardedModelSaver(boot_dir, sync=True) as s:
+        s.save(MultiLayerNetwork(build_conf()), step=0)
+    ck = os.path.join(work, "ck")
+    fstate = os.path.join(work, "fstate")
+    pstate = os.path.join(work, "pstate")
+
+    def free_port():
+        with socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            return sk.getsockname()[1]
+
+    router_port, status_port = free_port(), free_port()
+    router_url = f"http://127.0.0.1:{router_port}"
+    status_url = f"http://127.0.0.1:{status_port}"
+
+    def get_json(url, timeout=10.0):
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def scrape_pipeline_counters():
+        with urllib.request.urlopen(status_url + "/metrics",
+                                    timeout=10) as r:
+            text = r.read().decode()
+        out = {}
+        for line in text.splitlines():
+            if line.startswith("dl4j_pipeline_") and " " in line:
+                name = line.split("{", 1)[0]
+                try:
+                    out[name] = out.get(name, 0.0) + float(
+                        line.rsplit(" ", 1)[1])
+                except ValueError:
+                    pass
+        return out
+
+    fleet_cmd = [py, "-m", "deeplearning4j_tpu.cli", "fleet",
+                 "-m", boot_dir, "--replicas", "2",
+                 "--port", str(router_port), "--state-dir", fstate,
+                 "--heartbeat-interval", "0.2",
+                 "--request-timeout", "10"]
+
+    def launch_router():
+        p = subprocess.Popen(fleet_cmd, stdout=subprocess.PIPE,
+                             stderr=subprocess.DEVNULL, text=True,
+                             start_new_session=True, cwd=HERE)
+        for line in p.stdout:
+            if line.startswith("{") and '"router"' in line:
+                ann = json.loads(line)
+                threading.Thread(
+                    target=lambda: [None for _ in p.stdout],
+                    daemon=True).start()
+                return p, ann
+        p.kill()
+        raise RuntimeError("router never announced")
+
+    def launch_watchdog(args):
+        p = subprocess.Popen(
+            [py, "-m", "deeplearning4j_tpu.cli", "watchdog",
+             "--max-restarts", "4", "--backoff", "0.2", "--"] + args,
+            stdout=subprocess.PIPE, text=True, cwd=HERE)
+        return p
+
+    # hammer bookkeeping: (t, ok) per request; kill windows excuse
+    # failures between a kill and the first success after it
+    results, kills = [], []
+    hammer_stop = threading.Event()
+
+    def hammer():
+        body = json.dumps({"inputs": feats[:4].tolist()}).encode()
+        while not hammer_stop.is_set():
+            t = time.monotonic()
+            try:
+                req = urllib.request.Request(
+                    router_url + "/predict", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    ok = r.status == 200
+            except Exception:
+                ok = False
+            results.append((t, ok))
+            time.sleep(0.01)
+
+    def watch_children(proc, sink, tag):
+        """Drain a watchdog's stdout, recording child pids."""
+        def run():
+            for line in proc.stdout:
+                if not line.startswith("{"):
+                    continue
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                if "watchdog_child" in e:
+                    sink.setdefault(tag, []).append(e["watchdog_child"])
+                elif "watchdog_done" in e:
+                    sink[tag + "_done"] = True
+        threading.Thread(target=run, daemon=True).start()
+
+    p_router = p_train = p_pipe = None
+    replica_pids = []
+    children = {}
+    drill = {"kills": []}
+    try:
+        # ---- boot the serving side --------------------------------
+        p_router, ann = launch_router()
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if get_json(router_url + "/readyz",
+                        timeout=5).get("ready_replicas", 0) >= 2:
+                break
+            time.sleep(0.1)
+        snap = get_json(router_url + "/stats")["fleet"]
+        replica_pids = sorted(r["pid"]
+                              for r in snap["replicas"].values()
+                              if "pid" in r)
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+
+        # ---- the controller (under its watchdog) ------------------
+        p_pipe = launch_watchdog(
+            ["pipeline", "--checkpoint-dir", ck,
+             "--fleet-url", router_url, "--eval-data", holdout_csv,
+             "--eval-threshold", "0.5", "--regression-margin", "0.25",
+             "--poll-interval", "0.25", "--state-dir", pstate,
+             "--status-port", str(status_port), "--name", "bench"])
+        watch_children(p_pipe, children, "pipe")
+
+        # ---- the training side (under its watchdog) ---------------
+        p_train = launch_watchdog(
+            ["train", "--elastic", "2", "-i", train_csv,
+             "-m", conf_path, "-o", os.path.join(work, "out.ckpt"),
+             "--batch-size", "8", "--epochs", "4",
+             "--checkpoint-dir", ck, "--state-dir",
+             os.path.join(work, "tstate"),
+             "--straggler-factor", "50", "--run-timeout", "240",
+             "--checkpoint-keep", "100"])
+        watch_children(p_train, children, "train")
+
+        # ---- kill 1: the elastic SUPERVISOR, first commit seen ----
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if list_committed_steps(ck) and children.get("train"):
+                chaos_mod.sigkill(children["train"][0])
+                kills.append(("supervisor", time.monotonic()))
+                break
+            time.sleep(0.05)
+
+        # ---- kill 2: the CONTROLLER, first promotion landed -------
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                if scrape_pipeline_counters().get(
+                        "dl4j_pipeline_promotions_total", 0) >= 1 \
+                        and children.get("pipe"):
+                    chaos_mod.sigkill(children["pipe"][0])
+                    kills.append(("controller", time.monotonic()))
+                    break
+            except Exception:
+                pass
+            time.sleep(0.1)
+
+        # ---- kill 3: one REPLICA (fleet evicts, retries mask) -----
+        time.sleep(1.0)
+        if replica_pids:
+            chaos_mod.sigkill(replica_pids[-1])
+            kills.append(("replica", time.monotonic()))
+
+        # ---- kill 4: the ROUTER (bench plays watchdog) ------------
+        time.sleep(1.5)
+        chaos_mod.sigkill(p_router.pid)
+        kills.append(("router", time.monotonic()))
+        p_router, ann = launch_router()
+
+        # ---- training completes; poison steps ride the conveyor ---
+        deadline = time.time() + 240
+        while time.time() < deadline \
+                and not children.get("train_done"):
+            time.sleep(0.2)
+        t_last_commit = time.monotonic()
+        steps_now = list_committed_steps(ck)
+        last_good = steps_now[-1] if steps_now else None
+        wide = MultiLayerNetwork(build_conf(hidden=16))
+        wide.fit(feats[:192],
+                 np.eye(3, dtype=np.float32)[labels[:192]], epochs=40)
+        with ShardedModelSaver(ck, keep=50, sync=True) as s:
+            # random weights: fails the absolute gate -> quarantine
+            s.save(MultiLayerNetwork(build_conf()),
+                   step=(last_good or 0) + 1000)
+            # trained but arch-mismatched: PASSES the eval gate, then
+            # fails the canary reload -> rollback + quarantine
+            s.save(wide, step=(last_good or 0) + 2000)
+        poison_eval = (last_good or 0) + 1000
+        poison_canary = (last_good or 0) + 2000
+
+        # ---- convergence: newest eval-passed COMMITTED step -------
+        want_key = f"{os.path.abspath(ck)}@{last_good}"
+        t_converged = None
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            try:
+                served = get_json(router_url + "/stats")["fleet"][
+                    "checkpoints_served"]
+                q1 = os.path.exists(os.path.join(
+                    ck, ckfmt.step_dir_name(poison_eval),
+                    QUARANTINE_MARKER))
+                q2 = os.path.exists(os.path.join(
+                    ck, ckfmt.step_dir_name(poison_canary),
+                    QUARANTINE_MARKER))
+                if list(served) == [want_key] and q1 and q2:
+                    t_converged = time.monotonic()
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        time.sleep(1.0)  # post-convergence traffic for the audit
+        hammer_stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+        final_served = get_json(router_url + "/stats")["fleet"][
+            "checkpoints_served"]
+        counters = scrape_pipeline_counters()
+        pipe_status = get_json(status_url + "/status.json").get(
+            "extra", {})
+
+        # ---- the hammer audit -------------------------------------
+        def excused(t_fail):
+            # the documented readmission window after each kill: until
+            # the first post-kill success, and never shorter than 5 s
+            # (router relaunch + capacity-gap respawn + converge)
+            for _, t_k in kills:
+                if t_k <= t_fail:
+                    if t_fail <= t_k + 5.0:
+                        return True
+                    t_ok = next((t for t, ok in results
+                                 if ok and t > t_k), None)
+                    if t_ok is None or t_fail <= t_ok:
+                        return True
+            return False
+
+        failures = [t for t, ok in results if not ok]
+        unexcused = [t for t in failures if not excused(t)]
+        drill.update({
+            "kills": [k for k, _ in kills],
+            "requests": len(results),
+            "failures": len(failures),
+            "failures_outside_readmission": len(unexcused),
+            "champion_step": (pipe_status.get("champion") or {}).get(
+                "step"),
+            "last_good_step": last_good,
+            "checkpoints_served": final_served,
+            "quarantined": pipe_status.get("quarantined"),
+            "counters": counters,
+            "incarnations": {k: len(v) for k, v in children.items()
+                             if isinstance(v, list)},
+            "commit_to_served_s": (round(t_converged - t_last_commit,
+                                         3)
+                                   if t_converged else None),
+        })
+    finally:
+        hammer_stop.set()
+        for p in (p_router, p_train, p_pipe):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+        # the pipeline/train watchdog children + fleet replicas
+        for pids in children.values():
+            if isinstance(pids, list):
+                for pid in pids:
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except (OSError, ProcessLookupError):
+                        pass
+        for pid in replica_pids:
+            try:
+                os.killpg(pid, signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    pass
+
+    gate_converged = bool(
+        drill.get("champion_step") is not None
+        and drill["champion_step"] == drill.get("last_good_step")
+        and list(drill.get("checkpoints_served") or {})
+        == [f"{os.path.abspath(ck)}@{drill['last_good_step']}"])
+    gate_one_champion = len(drill.get("checkpoints_served") or {}) == 1
+    gate_quarantine = bool(
+        drill.get("quarantined")
+        and len(drill["quarantined"]) >= 2
+        and drill.get("counters", {}).get(
+            "dl4j_pipeline_quarantines_total", 0) >= 1
+        and drill.get("counters", {}).get(
+            "dl4j_pipeline_rollbacks_total", 0) >= 1)
+    gate_promoted = drill.get("counters", {}).get(
+        "dl4j_pipeline_promotions_total", 0) >= 1
+    gate_hammer = drill.get("failures_outside_readmission") == 0
+    gate_all_kills = len(drill.get("kills", [])) == 4
+
+    return {
+        "value": drill.get("commit_to_served_s"),
+        "unit": "s_last_commit_to_fleet_serving_it",
+        "lower_is_better": True,
+        "drill": drill,
+        "gate_all_four_kills_fired": gate_all_kills,
+        "gate_zero_errors_outside_readmission": gate_hammer,
+        "gate_no_torn_promotion_one_champion": gate_one_champion,
+        "gate_converged_to_newest_eval_passed": gate_converged,
+        "gate_regressor_quarantined_and_rolled_back": gate_quarantine,
+        "gate_promotions_scraped_live": gate_promoted,
+    }
+
+
 def bench_checkpoint():
     """Checkpoint subsystem config (docs/CHECKPOINTS.md): (a) the
     per-autosave STEP-LOOP STALL — blocking single-file npz writer
@@ -2033,6 +2422,7 @@ CONFIGS = {
     "chaos": bench_chaos,
     "train_elastic": bench_train_elastic,
     "controlplane": bench_controlplane,
+    "pipeline": bench_pipeline,
     "checkpoint": bench_checkpoint,
     "telemetry": bench_telemetry,
     "lenet": bench_lenet,
@@ -2054,6 +2444,7 @@ METRIC_NAMES = {
     "chaos": "chaos_sigstop_breaker_eviction_s",
     "train_elastic": "train_elastic_kill_recovery_s",
     "controlplane": "controlplane_router_restart_recovery_s",
+    "pipeline": "pipeline_commit_to_served_s",
     "checkpoint": "checkpoint_async_save_stall_ms",
     "telemetry": "telemetry_instrumented_step_time_ms",
     "lenet": "lenet_mnist_step_time_ms",
@@ -2147,7 +2538,7 @@ def main() -> None:
             res = CONFIGS[name]()
         except Exception as e:  # a broken config must not hide the others
             res = {"error": f"{type(e).__name__}: {e}"}
-        if "value" in res:
+        if res.get("value") is not None:
             # pins are per-platform: a CPU smoke run must never pin (or be
             # compared against) the TPU baselines the driver records
             platform = run_entry["platform"]

@@ -54,6 +54,8 @@ from deeplearning4j_tpu.checkpoint.convert import (  # noqa: F401
     updater_state_to_flat,
 )
 from deeplearning4j_tpu.checkpoint.restore import (  # noqa: F401
+    discover_latest,
+    list_committed_steps,
     load_payload_tree,
     restore_network,
     restore_params_for,
@@ -71,5 +73,6 @@ __all__ = [
     "AsyncCheckpointWriter", "snapshot_tree", "mesh_spec_of",
     "flat_to_updater_state", "updater_state_to_flat", "layer_slices",
     "restore_network", "restore_params_for", "load_payload_tree",
+    "discover_latest", "list_committed_steps",
     "validate_like", "ShardedModelSaver", "is_sharded_checkpoint",
 ]

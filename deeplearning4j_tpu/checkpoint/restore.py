@@ -22,12 +22,42 @@ params tree + per-layer UpdaterState + cursor, so DP ↔ ZeRO-1 ↔ TP and
 from __future__ import annotations
 
 import os
-from typing import Any, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 from deeplearning4j_tpu.checkpoint import format as ckfmt
 
-__all__ = ["resolve_root", "discover_latest", "load_payload_tree",
-           "restore_network", "restore_params_for", "validate_like"]
+__all__ = ["resolve_root", "discover_latest", "list_committed_steps",
+           "load_payload_tree", "restore_network", "restore_params_for",
+           "validate_like"]
+
+
+def list_committed_steps(root: str) -> List[int]:
+    """Ascending COMMITTED steps under `root`, hardened against a
+    concurrent writer's rotation/GC: a step directory (or its marker /
+    manifest) deleted between the listdir and the per-entry checks is
+    skipped, never raised. This is the deployment watcher's scan
+    primitive — it runs every poll interval against a root that an
+    `AsyncCheckpointWriter` is actively pruning, so every filesystem
+    probe must tolerate the entry vanishing under it."""
+    try:
+        entries = os.listdir(root)
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+    steps = []
+    for name in entries:
+        step = ckfmt.step_of(name)
+        if step is None:
+            continue
+        step_dir = os.path.join(root, name)
+        try:
+            committed = (os.path.exists(os.path.join(step_dir, ckfmt.MARKER))
+                         and os.path.exists(
+                             os.path.join(step_dir, ckfmt.MANIFEST)))
+        except OSError:
+            continue
+        if committed:
+            steps.append(step)
+    return sorted(steps)
 
 
 def resolve_root(path: str) -> Tuple[str, Optional[int]]:
@@ -52,9 +82,16 @@ def discover_latest(root: str) -> Tuple[str, int]:
     root, pinned = resolve_root(root)
     if pinned is not None:
         return root, pinned
-    steps = ckfmt.list_steps(root)
-    if steps:
-        return root, steps[-1]
+    # Newest-first, re-verifying each candidate's manifest is still
+    # readable: a concurrent writer's prune() can delete a step between
+    # our listdir and the manifest read — fall back to the next-older
+    # committed step instead of raising.
+    for step in reversed(list_committed_steps(root)):
+        try:
+            ckfmt.read_manifest(root, step)
+        except (ckfmt.CheckpointError, OSError, ValueError):
+            continue
+        return root, step
     torn = ckfmt.list_steps(root, committed_only=False)
     if torn:
         raise ckfmt.CheckpointError(
@@ -83,6 +120,7 @@ def restore_network(path: str, step: Optional[int] = None):
     and 'mesh' (the SOURCE topology, informational)."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
@@ -92,8 +130,15 @@ def restore_network(path: str, step: Optional[int] = None):
             f"Checkpoint {path} step {manifest['step']} has no conf_json "
             "(params-only runtime checkpoint); rebuild the network from "
             "its config and install payload['params'] directly")
-    net = MultiLayerNetwork.from_config_json(payload["conf_json"])
-    net._params = jax.tree_util.tree_map(jnp.asarray, payload["params"])
+    params = payload["params"]
+    if isinstance(params, dict):
+        net = MultiLayerNetwork.from_config_json(payload["conf_json"])
+        net._params = jax.tree_util.tree_map(jnp.asarray, params)
+    else:
+        # runtime-level packed vector (the elastic supervisor's wave
+        # checkpoints): unflatten against the conf's layer shapes
+        net = MultiLayerNetwork.from_config_json(
+            payload["conf_json"], params=np.asarray(params).ravel())
     if payload.get("updater_state") is not None:
         net._updater_state = jax.tree_util.tree_map(
             jnp.asarray, payload["updater_state"])

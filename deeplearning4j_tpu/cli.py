@@ -148,7 +148,8 @@ def cmd_train(args) -> int:
             # sharded async autosaves off the hot path (docs/CHECKPOINTS.md)
             from deeplearning4j_tpu.checkpoint import ShardedModelSaver
 
-            saver = ShardedModelSaver(args.checkpoint_dir)
+            saver = ShardedModelSaver(args.checkpoint_dir,
+                                      keep=args.checkpoint_keep)
         try:
             every = (args.checkpoint_every or 1
                      if saver is not None else None)
@@ -307,6 +308,7 @@ def _cmd_train_elastic(args) -> int:
             resume=args.resume,
             max_respawns=args.max_respawns,
             straggler_factor=args.straggler_factor,
+            keep_checkpoints=args.checkpoint_keep,
             status_port=args.status_port,
             state_dir=state_dir)
         if sup.status_server is not None:
@@ -380,8 +382,23 @@ def cmd_serve(args) -> int:
     try:
         net = _load_model(args.model)
         n_in = net.conf.confs[0].n_in
+        # initial checkpoint identity for /readyz//stats: what this
+        # server was LAUNCHED from (reloads overwrite it) — the fleet
+        # journal and the deployment controller read it end to end
+        ck = None
+        if os.path.isdir(args.model):
+            from deeplearning4j_tpu.checkpoint.restore import \
+                discover_latest
+            try:
+                _, ck_step = discover_latest(args.model)
+            except Exception:
+                ck_step = None
+            ck = {"path": os.path.abspath(args.model), "step": ck_step}
+        elif not args.model.endswith(".json"):
+            ck = {"path": os.path.abspath(args.model), "step": None}
         handle = serve_network(
-            net, host=args.host, port=args.port, n_replicas=args.replicas,
+            net, checkpoint=ck,
+            host=args.host, port=args.port, n_replicas=args.replicas,
             max_batch_size=args.max_batch_size,
             max_delay_ms=args.max_delay_ms,
             max_queue=args.max_queue,
@@ -658,6 +675,128 @@ def cmd_checkpoint(args) -> int:
     return 0
 
 
+def cmd_eval(args) -> int:
+    """`eval`: one-shot held-out evaluation of a checkpoint — the same
+    gate the deployment controller (`pipeline`) runs before promoting,
+    printing the same metrics JSON shape as `test`
+    (docs/PIPELINE.md)."""
+    from deeplearning4j_tpu.eval.holdout import evaluate_checkpoint
+
+    try:
+        out = evaluate_checkpoint(args.model, args.data,
+                                  label_columns=args.label_columns,
+                                  step=args.step)
+    except Exception as exc:  # noqa: BLE001 — CLI boundary
+        print(f"eval failed: {exc}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(out))
+    else:
+        print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_pipeline(args) -> int:
+    """`pipeline`: the crash-safe train→serve deployment controller —
+    watch --checkpoint-dir for newly COMMITTED steps, gate each on a
+    held-out eval, canary-promote it through the fleet's rolling
+    /reload, roll back + quarantine on failure (docs/PIPELINE.md).
+    Journals to --state-dir/controller.journal so a killed controller
+    (run it under `watchdog`) restarts into the same decision."""
+    from deeplearning4j_tpu.deploy import (ControllerBusy,
+                                           DeploymentController)
+
+    if bool(args.fleet_url) == bool(args.spawn_fleet):
+        print("pipeline needs exactly one of --fleet-url URL or "
+              "--spawn-fleet (with -m MODEL)", file=sys.stderr)
+        return 2
+    if args.spawn_fleet and not args.model:
+        print("--spawn-fleet needs -m MODEL for the replicas",
+              file=sys.stderr)
+        return 2
+    probe = None
+    if args.probe:
+        probe = json.loads(args.probe)
+    tele = _Telemetry(args)
+    fleet = None
+    handle = None
+    handoff_exit = bool(args.state_dir) and not args.smoke
+    ctrl = None
+    try:
+        if args.spawn_fleet:
+            from deeplearning4j_tpu.serving.fleet import (Fleet,
+                                                          ReplicaSpawner)
+            from deeplearning4j_tpu.serving.router import serve_fleet
+            fleet = Fleet(
+                spawner=ReplicaSpawner(args.model,
+                                       serve_args=args.serve_arg),
+                state_dir=(os.path.join(args.state_dir, "fleet")
+                           if args.state_dir else None),
+                initial_checkpoint=(args.model
+                                    if not args.model.endswith(".json")
+                                    else None))
+            have = sum(1 for r in fleet.snapshot()["replicas"].values()
+                       if r["spawned"] and r["state"] != "evicted")
+            if args.replicas > have:
+                fleet.spawn(args.replicas - have)
+            handle = serve_fleet(fleet, host=args.host, port=args.port)
+            fleet.wait_ready(1, timeout=args.ready_timeout)
+        ctrl = DeploymentController(
+            args.checkpoint_dir,
+            fleet=fleet,
+            fleet_url=args.fleet_url,
+            eval_data=args.eval_data,
+            label_columns=args.label_columns,
+            metric=args.metric,
+            eval_threshold=args.eval_threshold,
+            regression_margin=args.regression_margin,
+            poll_interval=args.poll_interval,
+            probe=probe,
+            state_dir=args.state_dir,
+            name=args.name,
+            status_port=args.status_port)
+    except ControllerBusy as exc:
+        print(f"pipeline already running: {exc}", file=sys.stderr)
+        if handle is not None:
+            handle.close(stop_replicas=not handoff_exit,
+                         handoff=handoff_exit)
+        elif fleet is not None:
+            fleet.close(stop_replicas=not handoff_exit,
+                        handoff=handoff_exit)
+        tele.close()
+        return 3
+    except BaseException:
+        if handle is not None:
+            handle.close(stop_replicas=not handoff_exit,
+                         handoff=handoff_exit)
+        elif fleet is not None:
+            fleet.close(stop_replicas=not handoff_exit,
+                        handoff=handoff_exit)
+        tele.close()
+        raise
+    print(json.dumps({"pipeline": ctrl.name,
+                      "checkpoint_dir": os.path.abspath(
+                          args.checkpoint_dir),
+                      "fleet": (handle.url if handle is not None
+                                else args.fleet_url),
+                      "status": ctrl.status_address,
+                      "incarnation": ctrl.incarnation,
+                      **tele.announce()}), flush=True)
+    try:
+        if args.smoke:
+            return 0
+        ctrl.run(max_cycles=args.cycles)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        ctrl.close(release=True)
+        if handle is not None:
+            handle.close(stop_replicas=not handoff_exit,
+                         handoff=handoff_exit)
+        tele.close()
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="deeplearning4j_tpu",
@@ -700,6 +839,14 @@ def build_parser() -> argparse.ArgumentParser:
                          help="autosave cadence in fit ticks (requires "
                               "--checkpoint-dir; default 1 when the dir "
                               "is set)")
+    p_train.add_argument("--checkpoint-keep", type=int, default=3,
+                         metavar="N",
+                         help="committed steps to retain under "
+                              "--checkpoint-dir (older steps are "
+                              "pruned); raise it when a deployment "
+                              "controller (`pipeline`) eval-gates the "
+                              "steps so candidates outlive the "
+                              "eval+canary window")
     p_train.add_argument("--resume", default=None, metavar="auto|PATH",
                          help="resume from a sharded checkpoint: 'auto' "
                               "discovers the latest COMMITTED step under "
@@ -893,6 +1040,97 @@ def build_parser() -> argparse.ArgumentParser:
                               "e.g. `-- train --elastic 2 "
                               "--state-dir S ...`")
     p_watch.set_defaults(fn=cmd_watchdog)
+
+    p_eval = sub.add_parser(
+        "eval",
+        help="one-shot held-out eval of a checkpoint — the pipeline's "
+             "promotion gate, runnable by hand (docs/PIPELINE.md)")
+    p_eval.add_argument("--model", "-m", required=True,
+                        help="conf .json (fresh net), .ckpt checkpoint, "
+                             "or sharded checkpoint dir")
+    p_eval.add_argument("--data", required=True,
+                        help="held-out CSV (features + trailing labels)")
+    p_eval.add_argument("--label-columns", type=int, default=1,
+                        help="trailing label columns (1 = integer class)")
+    p_eval.add_argument("--step", type=int, default=None,
+                        help="pin a committed step in a sharded dir "
+                             "(default: latest committed)")
+    p_eval.add_argument("--json", action="store_true",
+                        help="single-line machine-readable output")
+    p_eval.set_defaults(fn=cmd_eval)
+
+    p_pipe = sub.add_parser(
+        "pipeline",
+        help="crash-safe train->serve deployment controller: watch -> "
+             "eval gate -> canary promote -> rollback "
+             "(docs/PIPELINE.md)")
+    p_pipe.add_argument("--checkpoint-dir", required=True, metavar="DIR",
+                        help="sharded checkpoint root to watch for "
+                             "newly COMMITTED steps (the training "
+                             "side's --checkpoint-dir)")
+    p_pipe.add_argument("--fleet-url", default=None, metavar="URL",
+                        help="router URL of an already-running fleet "
+                             "(`fleet` subcommand) to drive over HTTP")
+    p_pipe.add_argument("--spawn-fleet", action="store_true",
+                        help="spawn the serving fleet in-process "
+                             "instead (needs -m MODEL; starts a router "
+                             "+ --replicas replica processes)")
+    p_pipe.add_argument("--model", "-m", default=None,
+                        help="checkpoint/conf served by --spawn-fleet "
+                             "replicas at boot")
+    p_pipe.add_argument("--replicas", type=int, default=2,
+                        help="--spawn-fleet: replica processes")
+    p_pipe.add_argument("--host", default="127.0.0.1")
+    p_pipe.add_argument("--port", type=int, default=0,
+                        help="--spawn-fleet: router port (0 = auto)")
+    p_pipe.add_argument("--ready-timeout", type=float, default=180.0,
+                        help="--spawn-fleet: wait for the first replica")
+    p_pipe.add_argument("--serve-arg", action="append", default=[],
+                        metavar="ARG",
+                        help="--spawn-fleet: extra flag forwarded to "
+                             "each replica's `serve` (repeatable)")
+    p_pipe.add_argument("--eval-data", default=None, metavar="CSV",
+                        help="held-out CSV for the promotion gate "
+                             "(omitted = gate disabled: every committed "
+                             "step is canaried)")
+    p_pipe.add_argument("--label-columns", type=int, default=1)
+    p_pipe.add_argument("--metric", default="f1",
+                        choices=("f1", "accuracy", "precision",
+                                 "recall"),
+                        help="gate metric from the held-out eval")
+    p_pipe.add_argument("--eval-threshold", type=float, default=0.0,
+                        help="absolute gate: quarantine a candidate "
+                             "scoring below this")
+    p_pipe.add_argument("--regression-margin", type=float, default=0.05,
+                        help="relative gate: quarantine a candidate "
+                             "scoring more than this below the current "
+                             "champion's gate score")
+    p_pipe.add_argument("--poll-interval", type=float, default=2.0,
+                        help="checkpoint-dir watch interval in seconds "
+                             "(bounded polling; no inotify)")
+    p_pipe.add_argument("--probe", default=None, metavar="JSON",
+                        help="validation probe body forwarded to the "
+                             "canary's /predict before promotion, e.g. "
+                             "'{\"inputs\": [[0,0,0,0]]}'")
+    p_pipe.add_argument("--state-dir", default=None, metavar="DIR",
+                        help="crash-safe control plane: journal the "
+                             "controller's decision state here "
+                             "(controller.journal) so a restart (see "
+                             "`watchdog`) resumes mid-promotion to a "
+                             "consistent verdict; --spawn-fleet also "
+                             "journals the fleet under DIR/fleet")
+    p_pipe.add_argument("--name", default=None,
+                        help="pipeline label on dl4j_pipeline_* series")
+    p_pipe.add_argument("--status-port", type=int, default=None,
+                        help="serve the controller's status/healthz/"
+                             "metrics endpoint (0 = auto-assign)")
+    p_pipe.add_argument("--cycles", type=int, default=None, metavar="N",
+                        help="exit 0 after N watch cycles (default: "
+                             "run until stopped)")
+    p_pipe.add_argument("--smoke", action="store_true",
+                        help="start, print the announce line, shut down")
+    telemetry_flags(p_pipe)
+    p_pipe.set_defaults(fn=cmd_pipeline)
     return parser
 
 

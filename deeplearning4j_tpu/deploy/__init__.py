@@ -1,0 +1,26 @@
+"""Deployment subsystem: the train→serve conveyor (docs/PIPELINE.md).
+
+`DeploymentController` closes the loop the rest of the repo built the
+two halves of: elastic training commits sharded checkpoints (PR 9/10),
+the serving fleet hot-reloads them with canary + rollback (PR 7) — this
+package watches the checkpoint directory, gates each newly COMMITTED
+step on a held-out evaluation, and drives the fleet's canary reload,
+promoting on probe success and rolling back + quarantining on failure.
+Its own decisions journal through `StateFile` (controller.journal) so a
+killed controller restarts into the same verdict; it runs under
+`cli watchdog` like the other control planes.
+"""
+
+from deeplearning4j_tpu.deploy.controller import (  # noqa: F401
+    CANARY,
+    ControllerBusy,
+    DeploymentController,
+    EVALUATING,
+    IDLE,
+    PROMOTING,
+    QUARANTINE_MARKER,
+    ROLLING_BACK,
+)
+
+__all__ = ["DeploymentController", "ControllerBusy", "QUARANTINE_MARKER",
+           "IDLE", "EVALUATING", "CANARY", "PROMOTING", "ROLLING_BACK"]

@@ -1,2 +1,6 @@
 from deeplearning4j_tpu.eval.evaluation import Evaluation  # noqa: F401
 from deeplearning4j_tpu.eval.confusion import ConfusionMatrix  # noqa: F401
+from deeplearning4j_tpu.eval.holdout import (  # noqa: F401
+    evaluate_checkpoint,
+    load_holdout_csv,
+)

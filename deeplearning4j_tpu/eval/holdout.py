@@ -1,0 +1,105 @@
+"""One-shot held-out evaluation of a checkpoint — the eval gate's meat.
+
+`evaluate_checkpoint(model, data)` loads any checkpoint the serving
+stack can load (sharded directory, single-file npz, or a conf .json for
+a fresh net), runs the held-out CSV through `Evaluation`, and returns
+the metrics dict both consumers speak:
+
+- `cli eval -m <checkpoint> --data <csv> --json` prints it (the same
+  {"f1", "accuracy", "precision", "recall"} shape `cli test` emits, plus
+  the checkpoint identity), and
+- the deployment controller's eval gate (deploy/controller.py) compares
+  it against its absolute threshold and the current champion's score
+  before offering a candidate to the fleet (docs/PIPELINE.md).
+
+Held-out CSV shape matches the rest of the CLI: one row per example,
+features then the label column(s) — an integer class column when
+`label_columns == 1` (one-hot expanded against the MODEL's output
+width, so a file missing the top class cannot shrink the label space).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+__all__ = ["evaluate_checkpoint", "load_holdout_csv"]
+
+
+def load_holdout_csv(path: str, label_columns: int = 1,
+                     n_classes: Optional[int] = None
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """(features, one-hot labels) from a labelled CSV. Raises on a
+    label-free file — a gate with no labels cannot gate."""
+    if label_columns < 1:
+        raise ValueError("held-out evaluation needs label_columns >= 1")
+    data = np.loadtxt(path, delimiter=",", dtype=np.float32, ndmin=2)
+    x = data[:, :-label_columns]
+    y = data[:, -label_columns:]
+    if label_columns == 1:  # integer class column -> one-hot
+        labels = y.astype(int).ravel()
+        classes = n_classes if n_classes else int(labels.max()) + 1
+        if labels.max() >= classes:
+            raise ValueError(
+                f"label {labels.max()} out of range for model with "
+                f"{classes} output classes")
+        y = np.eye(classes, dtype=np.float32)[labels]
+    return x, y
+
+
+def _load_net(model: str, step: Optional[int] = None):
+    """(net, checkpoint_step_or_None) for a sharded dir, npz file, or
+    conf .json — the same dispatch the serving reload path uses."""
+    if os.path.isdir(model):
+        from deeplearning4j_tpu.checkpoint.restore import restore_network
+
+        net, info = restore_network(model, step)
+        return net, info.get("step", step)
+    if model.endswith(".json"):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        with open(model) as f:
+            return MultiLayerNetwork.from_config_json(f.read()), None
+    if step is not None:
+        raise ValueError(
+            f"step={step} was requested but {model!r} is a single-file "
+            "checkpoint with no steps")
+    from deeplearning4j_tpu.scaleout.checkpoint import load_checkpoint
+
+    net, _ = load_checkpoint(model)
+    return net, None
+
+
+def evaluate_checkpoint(model: str, data: str, *,
+                        label_columns: int = 1,
+                        step: Optional[int] = None) -> dict:
+    """Evaluate `model` (checkpoint path) on the held-out CSV `data`.
+
+    Returns {"f1", "accuracy", "precision", "recall", "n", "path",
+    "step", "eval_seconds"} — step is the checkpoint's committed step
+    when it has one (sharded dirs), else None.
+    """
+    start = time.perf_counter()
+    net, ck_step = _load_net(model, step)
+    try:
+        n_out = net.conf.confs[-1].n_out or None
+    except (AttributeError, IndexError):
+        n_out = None
+    x, y = load_holdout_csv(data, label_columns, n_out)
+    ev = Evaluation()
+    ev.eval(y, np.asarray(net.output(x)))
+    return {
+        "f1": ev.f1(),
+        "accuracy": ev.accuracy(),
+        "precision": ev.precision(),
+        "recall": ev.recall(),
+        "n": int(x.shape[0]),
+        "path": model,
+        "step": ck_step,
+        "eval_seconds": round(time.perf_counter() - start, 6),
+    }

@@ -174,6 +174,11 @@ class InferenceEngine:
         #: True once warmup() precompiled every bucket — the readiness
         #: surface (/readyz, docs/FLEET.md) reads it
         self.warmed_up = False
+        #: checkpoint identity this engine serves ({path, step} or None
+        #: for constructor-installed params) — recorded by load_params,
+        #: surfaced through /readyz and /stats so the deployment
+        #: controller can verify a promotion landed (docs/PIPELINE.md)
+        self.checkpoint: Optional[dict] = None
         self.stats = EngineStats()
         from deeplearning4j_tpu.telemetry import device as _tdev
         _tdev.watch_jit_cache("serving_engine", self.program_cache_size)
@@ -333,7 +338,8 @@ class InferenceEngine:
             self.decode_loop.close()
 
     # ------------------------------------------------------- hot reload
-    def load_params(self, params) -> None:
+    def load_params(self, params, *,
+                    checkpoint: Optional[dict] = None) -> None:
         """Swap this engine's weights in place — zero-downtime reload.
 
         Validates the new tree leaf-for-leaf (structure + shapes, error
@@ -343,7 +349,11 @@ class InferenceEngine:
         params they already closed over, later requests see the new ones
         — nothing is dropped and no lock sits on the request path. The
         compiled bucket programs are reused as-is (params are a traced
-        argument, so same shapes = same program)."""
+        argument, so same shapes = same program).
+
+        `checkpoint` records the identity of what was just installed
+        ({path, step}); it becomes visible only after the swap, so a
+        reader never sees a new identity paired with old weights."""
         import jax
 
         from deeplearning4j_tpu.checkpoint.restore import validate_like
@@ -360,6 +370,7 @@ class InferenceEngine:
             # same single-reference swap: in-flight decode steps keep
             # the params they closed over, the next step sees new ones
             self.decode_loop.params = params
+        self.checkpoint = dict(checkpoint) if checkpoint else None
 
     # ---------------------------------------------------- observability
     def warmup(self, feature_shape: Sequence[int],
@@ -388,6 +399,7 @@ class InferenceEngine:
         snap = self.stats.snapshot()
         snap["buckets"] = list(self.buckets)
         snap["compiled_programs"] = self.program_cache_size()
+        snap["checkpoint"] = self.checkpoint
         if self.device is not None:
             snap["device"] = str(self.device)
         if self.decode_loop is not None:

@@ -256,6 +256,10 @@ class FleetReplica:
         out = {"url": self.client.url, "state": self.state,
                "outstanding": self.outstanding,
                "failures": self.failures, "spawned": self.spawned,
+               # what the replica itself says it serves ({path, step}
+               # or None), from its last /readyz payload — the per-
+               # replica identity the torn-promotion check aggregates
+               "checkpoint": (self.last_ready or {}).get("checkpoint"),
                "breaker": self.breaker.snapshot()}
         if self.adopted:
             out["adopted"] = True
@@ -452,6 +456,13 @@ class Fleet:
         #: rollback target of a failed canary (rolling_reload updates
         #: it; None until a reload or an explicit initial_checkpoint)
         self.current_checkpoint = initial_checkpoint
+        #: step of current_checkpoint once a rolling_reload pinned one.
+        #: While set, every replica ADMITTED into rotation (capacity-gap
+        #: spawn, readmission, adoption) is first converged onto exactly
+        #: this checkpoint@step — a promotion can never end up torn by
+        #: later capacity repair. None = never promoted: boot-time
+        #: heterogeneity is the operator's business, not ours.
+        self.current_step: Optional[int] = None
         # the scaleout control-plane tracker IS the health store:
         # heartbeat() on probe success (re-registers evicted members),
         # stale_workers() drives eviction — runtime._evict_stale's idiom
@@ -666,7 +677,9 @@ class Fleet:
             replicas = {}
             for rid, rep in self._replicas.items():
                 entry = {"url": rep.client.url, "state": rep.state,
-                         "spawned": rep.spawned}
+                         "spawned": rep.spawned,
+                         "checkpoint": (rep.last_ready
+                                        or {}).get("checkpoint")}
                 if rep.proc is not None:
                     entry["pid"] = rep.proc.pid
                     entry["start_time"] = rep.start_time
@@ -676,6 +689,7 @@ class Fleet:
                 "fleet": self.label,
                 "incarnation": self.incarnation,
                 "current_checkpoint": self.current_checkpoint,
+                "current_step": self.current_step,
                 "replicas": replicas,
                 "written_at": time.time(),
             }
@@ -694,6 +708,7 @@ class Fleet:
         self._adopting = True
         if self.current_checkpoint is None:
             self.current_checkpoint = prior.get("current_checkpoint")
+            self.current_step = prior.get("current_step")
         max_rid = -1
         for rid, e in (prior.get("replicas") or {}).items():
             if rid.startswith("r"):
@@ -880,7 +895,43 @@ class Fleet:
             if rep.state in (READY, SUSPECT):
                 self._evict(rep, payload.get("reason", "readiness lost"))
 
+    def _needs_converge(self, rep: FleetReplica) -> bool:
+        """True when `rep` reports a checkpoint identity other than the
+        pinned current_checkpoint@current_step. Only armed once a
+        rolling_reload pinned a step: before any promotion the fleet
+        has no opinion on what its members serve."""
+        if self.current_step is None or self.current_checkpoint is None:
+            return False
+        if self._reload_active:
+            return False  # rolling_reload is rewriting identity now
+        ck = (rep.last_ready or {}).get("checkpoint") or {}
+        path = ck.get("path")
+        return not (path
+                    and os.path.abspath(path)
+                    == os.path.abspath(self.current_checkpoint)
+                    and ck.get("step") == self.current_step)
+
     def _admit(self, rep: FleetReplica) -> None:
+        if self._needs_converge(rep):
+            # a newcomer (capacity-gap spawn, readmitted eviction, late
+            # adoption) must not enter rotation serving anything but the
+            # promoted champion — THAT would be a torn promotion. Bring
+            # it to current_checkpoint@current_step first; on failure it
+            # stays out of rotation and the next monitor pass retries —
+            # dark beats stale.
+            ok, info = self._reload_one(
+                rep, self.current_checkpoint, self.current_step,
+                None, ready_timeout=max(30.0, self.request_timeout))
+            if not ok:
+                log.warning(
+                    "fleet %s: replica %s failed to converge onto "
+                    "%s@%s (%s); held out of rotation", self.label,
+                    rep.id, self.current_checkpoint, self.current_step,
+                    info.get("error"))
+                return
+            log.info("fleet %s: replica %s converged onto %s@%s before "
+                     "admission", self.label, rep.id,
+                     self.current_checkpoint, self.current_step)
         with self._lock:
             was_evicted = rep.state == EVICTED
             rep.state = READY
@@ -1190,9 +1241,15 @@ class Fleet:
         ready = False
         while time.monotonic() < deadline:
             try:
-                ready, _ = rep.client.readyz(timeout=self.probe_timeout)
+                ready, ready_payload = rep.client.readyz(
+                    timeout=self.probe_timeout)
             except Exception:
                 ready = False
+            else:
+                # refresh the identity snapshot NOW — journal/stats
+                # must show the reloaded checkpoint without waiting a
+                # heartbeat (the deployment controller reads this)
+                rep.last_ready = ready_payload
             if ready:
                 break
             time.sleep(0.05)
@@ -1289,6 +1346,7 @@ class Fleet:
                 self._m_reloads[outcome].inc()
                 return result
             self.current_checkpoint = path
+            self.current_step = step
             self._m_reloads["ok"].inc()
             self._journal_write()  # the serving checkpoint is journaled
             # state: a restarted router must know the rollback target
@@ -1381,8 +1439,20 @@ class Fleet:
         for rid, hb in heartbeats.items():
             if rid in reps:
                 reps[rid]["heartbeat_age_s"] = round(now - hb, 3)
+        # per-checkpoint-identity aggregation: "path@step" -> [rids].
+        # The deployment controller's torn-promotion gate reads this
+        # off the router's /stats — a converged fleet shows exactly one
+        # identity key across its READY replicas (docs/PIPELINE.md)
+        served: Dict[str, list] = {}
+        for rid, r in sorted(reps.items()):
+            if r.get("state") == EVICTED:
+                continue  # not serving: a stale identity is not "served"
+            ck = r.get("checkpoint")
+            key = (f"{ck.get('path')}@{ck.get('step')}" if ck else "none")
+            served.setdefault(key, []).append(rid)
         return {
             "replicas": reps,
+            "checkpoints_served": served,
             "states": self.state_counts(),
             "breakers": self.breaker_counts(),
             "outstanding": self.total_outstanding(),
@@ -1391,6 +1461,7 @@ class Fleet:
             "adoptions": list(self.adoption_events),
             "shed_high_water": self.shed_high_water,
             "current_checkpoint": self.current_checkpoint,
+            "current_step": self.current_step,
             "rolling_reload_active": self._reload_active,
             "retry_budget": self.retry_budget,
             "requests": {route: int(c.value)

@@ -134,12 +134,13 @@ class ReplicaSet:
         return MicroBatcher(self.infer, **kw)
 
     # --------------------------------------------------------- hot reload
-    def load_params(self, params) -> None:
+    def load_params(self, params, *, checkpoint=None) -> None:
         """Swap every replica's weights (each engine validates shapes
         and swaps atomically — in-flight requests finish on the old
-        params; see InferenceEngine.load_params)."""
+        params; see InferenceEngine.load_params). `checkpoint` records
+        the served identity ({path, step}) on every engine."""
         for engine in self.engines:
-            engine.load_params(params)
+            engine.load_params(params, checkpoint=checkpoint)
 
     def load_checkpoint(self, path: str, step: Optional[int] = None) -> dict:
         """Hot-reload all replicas from a checkpoint — a sharded
@@ -167,10 +168,19 @@ class ReplicaSet:
                 load_checkpoint
 
             net, info = load_checkpoint(path)
-        self.load_params(net.param_table)
+        self.load_params(net.param_table,
+                         checkpoint={"path": os.path.abspath(path),
+                                     "step": info.get("step", step)})
         return info
 
     # ---------------------------------------------------- observability
+    @property
+    def checkpoint(self):
+        """Checkpoint identity the set serves ({path, step} or None) —
+        every engine gets the same identity through load_params, so the
+        first engine speaks for the set."""
+        return self.engines[0].checkpoint
+
     def program_cache_size(self) -> int:
         sizes = [e.program_cache_size() for e in self.engines]
         return -1 if any(s < 0 for s in sizes) else sum(sizes)
@@ -188,6 +198,7 @@ class ReplicaSet:
                 buckets[b] = buckets.get(b, 0) + c
         return {
             "replicas": len(self.engines),
+            "checkpoint": self.checkpoint,
             "requests": sum(r["requests"] for r in reps),
             "rows": sum(r["rows"] for r in reps),
             "errors": sum(r["errors"] for r in reps),

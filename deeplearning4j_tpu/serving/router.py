@@ -425,9 +425,11 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             if not path:
                 raise ValueError("reload needs {'path': <checkpoint>}")
             step = data.get("step")
+            rb_step = data.get("rollback_step")
             result = fleet.rolling_reload(
                 str(path), step=None if step is None else int(step),
                 rollback_path=data.get("rollback_path"),
+                rollback_step=None if rb_step is None else int(rb_step),
                 probe=data.get("probe"))
             self._reply(200 if result.get("reloaded") else 409, result)
 

@@ -170,7 +170,12 @@ class ServingHandle:
             reasons.append("batcher worker not running")
         out = {"ready": not reasons,
                "warmup_done": self._warmed.is_set(),
-               "replicas": len(self.replicas.engines)}
+               "replicas": len(self.replicas.engines),
+               # checkpoint identity ({path, step} or None): the fleet
+               # journal and the deployment controller's convergence
+               # check read WHAT this replica serves from the same
+               # probe that gates admission (docs/PIPELINE.md)
+               "checkpoint": self.replicas.checkpoint}
         if loop is not None:
             out["decode_loop_alive"] = loop.alive
         if reasons:
@@ -179,6 +184,7 @@ class ServingHandle:
 
     def stats(self) -> dict:
         out = {"uptime_s": round(time.time() - self.started_at, 3),
+               "checkpoint": self.replicas.checkpoint,
                "replicas": self.replicas.snapshot()}
         if self.batcher is not None:
             out["batcher"] = self.batcher.snapshot()
@@ -215,7 +221,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                   decode_kernel: str = "auto",
                   host: str = "127.0.0.1", port: int = 0,
                   warmup_shape=None,
-                  warmup_async: bool = False) -> ServingHandle:
+                  warmup_async: bool = False,
+                  checkpoint: Optional[dict] = None) -> ServingHandle:
     """Serve a MultiLayerNetwork (or a prebuilt ReplicaSet) over HTTP.
 
     Pass `net` for the common case — a replica set is built across
@@ -237,13 +244,22 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
     individual requests opt out with `"prefix_cache": false` in the
     /generate body. `decode_kernel` picks the decode attention lane
     ("auto" = Pallas paged kernel on TPU, dense gather elsewhere;
-    docs/SERVING.md "Decode kernel").
+    docs/SERVING.md "Decode kernel"). `checkpoint` ({path, step})
+    stamps the initial checkpoint identity on the replicas when the
+    served model came from a checkpoint — /readyz, /stats, and the
+    fleet journal report it (docs/PIPELINE.md).
     """
     if replicas is None:
         if net is None:
             raise ValueError("serve_network needs net= or replicas=")
         replicas = ReplicaSet.for_network(net, n_replicas=n_replicas,
                                           max_batch_size=max_batch_size)
+    if checkpoint:
+        # initial checkpoint identity (the model was constructed FROM a
+        # checkpoint rather than reloaded onto a live server): stamp it
+        # on every engine so /readyz reports it from the first probe
+        for _e in replicas.engines:
+            _e.checkpoint = dict(checkpoint)
     warm = tuple(warmup_shape) if warmup_shape is not None else None
     if warm is not None and not warmup_async:
         replicas.warmup(warm)
@@ -423,6 +439,7 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                 "step": info.get("step"),
                 "iterator_position": info.get("iterator_position"),
                 "replicas": len(replicas.engines),
+                "checkpoint": replicas.checkpoint,
             })
 
         def _generate(self):

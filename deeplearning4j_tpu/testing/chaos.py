@@ -104,6 +104,22 @@ POINTS = {
     "fleet.journal": "serving control-plane journal (the fleet/router "
                      "twin of supervisor.journal; same write/rename "
                      "ordinals and atomicity contract)",
+    "pipeline.watch": "deployment controller's checkpoint-directory "
+                      "scan, before each poll's committed-step listing "
+                      "(errors = an unreadable checkpoint root the "
+                      "watcher must survive and retry)",
+    "pipeline.eval": "deployment controller's eval gate, before the "
+                     "held-out evaluation of a candidate runs (errors "
+                     "leave the candidate pending — an eval that could "
+                     "not run is NOT a failed eval, docs/PIPELINE.md)",
+    "pipeline.promote": "deployment controller, before the canary "
+                        "rolling reload is driven (errors mid-decision "
+                        "leave the fleet on exactly one champion — the "
+                        "journal resumes the promotion)",
+    "controller.journal": "deployment controller journal (the deploy-"
+                          "plane twin of supervisor.journal; same "
+                          "write/rename ordinals and atomicity "
+                          "contract)",
 }
 
 

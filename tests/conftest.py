@@ -62,6 +62,12 @@ def pytest_configure(config):
         "runs these through the interpreter on CPU, the same kernel "
         "code compiles on TPU — run just this layer with "
         "pytest -m pallas")
+    config.addinivalue_line(
+        "markers",
+        "pipeline: train->serve deployment-controller drills "
+        "(deploy/controller.py conveyor: watch -> eval gate -> canary "
+        "promote -> rollback); the in-process drills run in tier-1 — "
+        "run the whole layer with pytest -m pipeline")
 
 
 def pytest_collection_modifyitems(config, items):

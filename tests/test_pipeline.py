@@ -1,0 +1,695 @@
+"""Deployment-controller conveyor drills (deploy/controller.py).
+
+The flagship tests run the REAL path end to end in-process: sharded
+checkpoints committed by `ShardedModelSaver`, `serve_network` replica
+endpoints behind a `Fleet(start=False)` driven inline, and the
+controller's watch → eval gate → canary promote → rollback loop on top.
+Crash-consistency drills restart a controller over a journal captured
+mid-promotion and assert it resumes to the same verdict; the chaos
+fault matrix walks every pipeline injection point and checks the
+journal stays readable and the fleet lands on exactly one champion.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.checkpoint import ShardedModelSaver
+from deeplearning4j_tpu.checkpoint import format as ckfmt
+from deeplearning4j_tpu.checkpoint.restore import (discover_latest,
+                                                   list_committed_steps)
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.deploy import (CANARY, ControllerBusy,
+                                       DeploymentController,
+                                       QUARANTINE_MARKER, ROLLING_BACK)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import Fleet, serve_fleet, serve_network
+from deeplearning4j_tpu.testing import chaos
+
+pytestmark = pytest.mark.pipeline
+
+
+def _net(n_in=4, n_out=3, hidden=8):
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(n_in).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([hidden])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=n_out)
+            .pretrain(False).build())
+    return MultiLayerNetwork(conf)
+
+
+def _dataset(n=96, seed=0):
+    """Linearly separable 3-class clusters in R^4: a fit net scores
+    near 1.0, a random-init net near 1/3 — a reliable gate spread."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 3, n)
+    centers = np.eye(3, 4, dtype=np.float32) * 4.0
+    x = (centers[labels] + 0.3 * rng.randn(n, 4)).astype(np.float32)
+    return x, labels
+
+
+def _holdout_csv(tmp_path, n=48, seed=7) -> str:
+    x, labels = _dataset(n, seed)
+    path = str(tmp_path / "holdout.csv")
+    np.savetxt(path, np.hstack([x, labels[:, None]]), delimiter=",")
+    return path
+
+
+def _trained_net():
+    x, labels = _dataset(96, seed=0)
+    y = np.eye(3, dtype=np.float32)[labels]
+    net = _net()
+    net.fit(x, y, epochs=40)
+    return net
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _poll_until_ready(fleet, n, tries=100):
+    for _ in range(tries):
+        fleet.poll()
+        if fleet.ready_count() >= n:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"only {fleet.ready_count()}/{n} ready: {fleet.state_counts()}")
+
+
+def _fleet(boot_net, boot_dir, n=2):
+    handles = [serve_network(boot_net, n_replicas=1, max_delay_ms=1.0,
+                             warmup_shape=(4,),
+                             checkpoint={"path": boot_dir, "step": 0})
+               for _ in range(n)]
+    fleet = Fleet(start=False, heartbeat_timeout=10.0,
+                  initial_checkpoint=boot_dir)
+    for h in handles:
+        fleet.attach(h.url)
+    _poll_until_ready(fleet, n)
+    return handles, fleet
+
+
+def _close(fleet, handles, *ctrls):
+    for c in ctrls:
+        c.close()
+    fleet.close()
+    for h in handles:
+        h.close()
+
+
+class TestConveyor:
+    def test_commit_eval_promote_end_to_end(self, tmp_path):
+        """Happy path: a newly COMMITTED step passes the gate, canaries
+        through the fleet, and every replica reports the promoted
+        checkpoint identity (satellite: /readyz + /stats + fleet
+        snapshot all carry it)."""
+        good = _trained_net()
+        ck_dir = str(tmp_path / "ck")
+        with ShardedModelSaver(ck_dir, sync=True) as s:
+            s.save(good, step=1)
+        csv = _holdout_csv(tmp_path)
+        boot_dir = str(tmp_path / "boot")
+        with ShardedModelSaver(boot_dir, sync=True) as s:
+            s.save(_net(), step=0)
+        handles, fleet = _fleet(_net(), boot_dir, n=2)
+        ctrl = DeploymentController(
+            ck_dir, fleet=fleet, eval_data=csv, eval_threshold=0.6,
+            poll_interval=0.01, state_dir=str(tmp_path / "state"),
+            name="e2e")
+        try:
+            out = ctrl.run_once()
+            assert out == {"action": "promote", "promoted": True,
+                           "step": 1}
+            assert ctrl.champion["step"] == 1
+            assert ctrl.champion["metrics"]["f1"] >= 0.8
+            # identity converged everywhere: replica /readyz + /stats,
+            # fleet snapshot aggregation
+            want = os.path.abspath(ck_dir)
+            for h in handles:
+                ready = _get(f"{h.url}/readyz")
+                assert ready["checkpoint"] == {"path": want, "step": 1}
+                assert h.stats()["checkpoint"]["step"] == 1
+            snap = fleet.snapshot()
+            assert list(snap["checkpoints_served"]) == [f"{want}@1"]
+            assert len(snap["checkpoints_served"][f"{want}@1"]) == 2
+            # quiesced: nothing newer than the champion
+            assert ctrl.run_once() == {"action": "idle"}
+            # a newer commit rides the same conveyor
+            with ShardedModelSaver(ck_dir, sync=True) as s:
+                s.save(good, step=2)
+            out = ctrl.run_once()
+            assert out["promoted"] and out["step"] == 2
+            assert ctrl.status()["counters"]["promotions"] == 2
+            assert ctrl.status()["counters"]["eval_pass"] == 2
+        finally:
+            _close(fleet, handles, ctrl)
+
+    def test_eval_gate_quarantines_bad_checkpoint(self, tmp_path):
+        """A poisoned (random-weights) step fails the absolute gate:
+        QUARANTINED marker lands in its step dir, the fleet is never
+        touched, and the conveyor falls back to the best remaining
+        step. A later regressing step trips the champion-relative
+        gate too."""
+        ck_dir = str(tmp_path / "ck")
+        with ShardedModelSaver(ck_dir, sync=True) as s:
+            s.save(_trained_net(), step=1)
+            s.save(_net(), step=2)  # poisoned: untrained
+        csv = _holdout_csv(tmp_path)
+        boot_dir = str(tmp_path / "boot")
+        with ShardedModelSaver(boot_dir, sync=True) as s:
+            s.save(_net(), step=0)
+        handles, fleet = _fleet(_net(), boot_dir, n=2)
+        ctrl = DeploymentController(
+            ck_dir, fleet=fleet, eval_data=csv, eval_threshold=0.6,
+            regression_margin=0.05, poll_interval=0.01, name="gate")
+        try:
+            # newest-first: step 2 is offered, rejected, quarantined
+            out = ctrl.run_once()
+            assert out == {"action": "eval", "step": 2,
+                           "promoted": False}
+            marker = os.path.join(ck_dir, ckfmt.step_dir_name(2),
+                                  QUARANTINE_MARKER)
+            assert os.path.exists(marker)
+            with open(marker) as f:
+                assert "eval_gate" in json.load(f)["reason"]
+            assert fleet.snapshot()["reloads"]["ok"] == 0
+            # the conveyor falls back to step 1, which promotes
+            out = ctrl.run_once()
+            assert out["promoted"] and out["step"] == 1
+            # a regressing step 3 (random again) trips the relative
+            # gate against the step-1 champion
+            with ShardedModelSaver(ck_dir, sync=True) as s:
+                s.save(_net(), step=3)
+            out = ctrl.run_once()
+            assert out == {"action": "eval", "step": 3,
+                           "promoted": False}
+            assert ctrl.champion["step"] == 1
+            assert set(ctrl.quarantined) == {"2", "3"}
+            assert ctrl.status()["counters"]["quarantines"] == 2
+            assert fleet.snapshot()["reloads"]["ok"] == 1
+            # quarantined steps are never re-offered
+            assert ctrl.run_once() == {"action": "idle"}
+        finally:
+            _close(fleet, handles, ctrl)
+
+    def test_failed_canary_rolls_back_and_quarantines(self, tmp_path):
+        """A checkpoint the canary cannot serve (arch mismatch) reaches
+        a definitive fleet verdict: the controller journals
+        ROLLING_BACK, quarantines the step, and the fleet stays on the
+        champion's weights."""
+        good = _trained_net()
+        ck_dir = str(tmp_path / "ck")
+        with ShardedModelSaver(ck_dir, sync=True) as s:
+            s.save(good, step=1)
+        boot_dir = str(tmp_path / "boot")
+        with ShardedModelSaver(boot_dir, sync=True) as s:
+            s.save(_net(), step=0)
+        handles, fleet = _fleet(_net(), boot_dir, n=2)
+        ctrl = DeploymentController(ck_dir, fleet=fleet,
+                                    poll_interval=0.01, name="canary")
+        try:
+            assert ctrl.run_once()["promoted"]  # step 1 = champion
+            # step 2 has a WIDER hidden layer: the replica's /reload
+            # rejects it — a definitive canary failure
+            with ShardedModelSaver(ck_dir, sync=True) as s:
+                s.save(_net(hidden=16), step=2)
+            out = ctrl.run_once()
+            assert out["promoted"] is False and out["rolled_back"]
+            assert ctrl.champion["step"] == 1
+            assert "2" in ctrl.quarantined
+            assert "canary" in ctrl.quarantined["2"]
+            assert ctrl.status()["counters"]["rollbacks"] == 1
+            want = os.path.abspath(ck_dir)
+            snap = fleet.snapshot()
+            assert list(snap["checkpoints_served"]) == [f"{want}@1"]
+            x = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+            ref = np.asarray(good.output(x))
+            for h in handles:
+                req = urllib.request.Request(
+                    f"{h.url}/predict",
+                    data=json.dumps({"inputs": x.tolist()}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    out_p = json.loads(r.read())["outputs"]
+                np.testing.assert_allclose(np.asarray(out_p), ref,
+                                           atol=1e-5)
+        finally:
+            _close(fleet, handles, ctrl)
+
+    def test_probe_failure_rolls_back(self, tmp_path):
+        """A canary that reloads but fails the validation probe rolls
+        back: the fleet's all-or-nothing reload plus the controller's
+        quarantine verdict."""
+        ck_dir = str(tmp_path / "ck")
+        with ShardedModelSaver(ck_dir, sync=True) as s:
+            s.save(_trained_net(), step=1)
+        boot_dir = str(tmp_path / "boot")
+        with ShardedModelSaver(boot_dir, sync=True) as s:
+            s.save(_net(), step=0)
+        handles, fleet = _fleet(_net(), boot_dir, n=2)
+        # the probe's feature width is wrong -> the canary's /predict
+        # validation 400s after a successful reload
+        ctrl = DeploymentController(
+            ck_dir, fleet=fleet, probe={"inputs": [[1.0, 2.0]]},
+            poll_interval=0.01, name="probe")
+        try:
+            out = ctrl.run_once()
+            assert out["promoted"] is False and out["rolled_back"]
+            assert ctrl.champion is None
+            assert "1" in ctrl.quarantined
+            assert fleet.snapshot()["reloads"]["rolled_back"] == 1
+            # boot identity still served — the canary came back
+            want_key = f"{boot_dir}@0"
+            assert list(fleet.snapshot()["checkpoints_served"]) \
+                == [want_key]
+        finally:
+            _close(fleet, handles, ctrl)
+
+
+class TestRouterDriven:
+    def test_promote_and_quarantine_over_http(self, tmp_path):
+        """The fleet_url lane: the controller drives POST /reload on a
+        live router, 200 promotes, 409 (canary failure) quarantines;
+        the router's /stats aggregates per-replica identity."""
+        ck_dir = str(tmp_path / "ck")
+        with ShardedModelSaver(ck_dir, sync=True) as s:
+            s.save(_trained_net(), step=1)
+        boot_dir = str(tmp_path / "boot")
+        with ShardedModelSaver(boot_dir, sync=True) as s:
+            s.save(_net(), step=0)
+        handles, fleet = _fleet(_net(), boot_dir, n=2)
+        try:
+            with serve_fleet(fleet) as router:
+                ctrl = DeploymentController(
+                    ck_dir, fleet_url=router.url, poll_interval=0.01,
+                    name="http")
+                out = ctrl.run_once()
+                assert out == {"action": "promote", "promoted": True,
+                               "step": 1}
+                want = os.path.abspath(ck_dir)
+                stats = _get(f"{router.url}/stats")["fleet"]
+                assert list(stats["checkpoints_served"]) == [f"{want}@1"]
+                # arch mismatch -> router answers 409: definitive
+                with ShardedModelSaver(ck_dir, sync=True) as s:
+                    s.save(_net(hidden=16), step=2)
+                out = ctrl.run_once()
+                assert out["promoted"] is False and out["rolled_back"]
+                assert ctrl.champion["step"] == 1
+                assert "2" in ctrl.quarantined
+                ctrl.close()
+        finally:
+            _close(fleet, handles)
+
+    def test_unreachable_fleet_leaves_candidate_pending(self, tmp_path):
+        """Infra failure is NOT a verdict: an unreachable router leaves
+        the candidate pending (no quarantine), and the same step
+        promotes once the fleet exists."""
+        ck_dir = str(tmp_path / "ck")
+        with ShardedModelSaver(ck_dir, sync=True) as s:
+            s.save(_trained_net(), step=1)
+        ctrl = DeploymentController(
+            ck_dir, fleet_url="http://127.0.0.1:9", poll_interval=0.01,
+            request_timeout=0.5, name="pending")
+        try:
+            out = ctrl.run_once()
+            assert out["promoted"] is False and out.get("pending")
+            assert ctrl.quarantined == {}
+            assert ctrl.champion is None
+            assert ctrl.phase == "idle"
+        finally:
+            ctrl.close()
+
+
+class _StubFleet:
+    """In-memory stand-in recording which checkpoint the 'fleet'
+    serves — the chaos matrix only needs reload semantics, not HTTP."""
+
+    label = "stub"
+
+    def __init__(self, boot=("boot", 0)):
+        self.current = boot
+        self.reloads = []
+        self.fail_next = None  # None | "definitive" | "infra"
+
+    def rolling_reload(self, path, step=None, rollback_path=None,
+                       rollback_step=None, probe=None, **kw):
+        from deeplearning4j_tpu.serving.fleet import NoReadyReplicas
+        self.reloads.append((path, step))
+        if self.fail_next == "infra":
+            self.fail_next = None
+            raise NoReadyReplicas("stub: nobody home")
+        if self.fail_next == "definitive":
+            self.fail_next = None
+            return {"reloaded": False, "canary": True,
+                    "error": {"stage": "probe"}, "rolled_back": []}
+        self.current = (path, step)
+        return {"reloaded": True, "replicas": ["r0"]}
+
+
+def _commit_step(ck_dir, step):
+    with ShardedModelSaver(ck_dir, sync=True) as s:
+        s.save(_net(), step=step)
+
+
+class TestCrashConsistency:
+    def test_double_start_lock(self, tmp_path):
+        ck_dir = str(tmp_path / "ck")
+        _commit_step(ck_dir, 1)
+        state = str(tmp_path / "state")
+        ctrl = DeploymentController(ck_dir, fleet=_StubFleet(),
+                                    state_dir=state, name="lock")
+        try:
+            with pytest.raises(ControllerBusy):
+                DeploymentController(ck_dir, fleet=_StubFleet(),
+                                     state_dir=state, name="lock2")
+        finally:
+            ctrl.close(release=True)
+        # a released journal admits a successor, which adopts the state
+        ctrl2 = DeploymentController(ck_dir, fleet=_StubFleet(),
+                                     state_dir=state, name="lock3")
+        assert ctrl2.incarnation == 1
+        ctrl2.close()
+
+    def _dead_owner_journal(self, ctrl, **overrides):
+        """Re-write the journal as a DEAD prior incarnation left it —
+        the kill -9 drill without killing the test process."""
+        state = ctrl.journal.read()
+        state["owner"] = {"pid": 2 ** 30, "start_time": 1.0}
+        state.update(overrides)
+        ctrl.journal.write(state)
+
+    def test_kill_mid_promotion_resumes_to_promoted(self, tmp_path):
+        """A controller that died between journaling CANARY and the
+        fleet verdict re-drives the (idempotent) reload on restart and
+        lands promoted — never torn."""
+        ck_dir = str(tmp_path / "ck")
+        _commit_step(ck_dir, 1)
+        state = str(tmp_path / "state")
+        stub = _StubFleet()
+        ctrl = DeploymentController(ck_dir, fleet=stub, state_dir=state,
+                                    name="kill")
+        self._dead_owner_journal(
+            ctrl, phase=CANARY,
+            candidate={"path": os.path.abspath(ck_dir), "step": 1,
+                       "metrics": None})
+        ctrl.close(release=False)
+        ctrl2 = DeploymentController(ck_dir, fleet=stub, state_dir=state,
+                                     name="kill")
+        try:
+            assert ctrl2.incarnation == 1
+            assert ctrl2.phase == CANARY  # journaled decision adopted
+            out = ctrl2.run_once()
+            assert out["promoted"] and out["step"] == 1
+            assert stub.current == (os.path.abspath(ck_dir), 1)
+            assert ctrl2.champion["step"] == 1
+            assert ctrl2.status()["counters"]["promotions"] == 1
+        finally:
+            ctrl2.close()
+
+    def test_kill_mid_rollback_reasserts_champion(self, tmp_path):
+        """Dying inside ROLLING_BACK: the failure verdict was already
+        decided — the restart re-asserts the champion on the fleet and
+        finishes the quarantine."""
+        ck_dir = str(tmp_path / "ck")
+        _commit_step(ck_dir, 1)
+        _commit_step(ck_dir, 2)
+        state = str(tmp_path / "state")
+        stub = _StubFleet()
+        ctrl = DeploymentController(ck_dir, fleet=stub, state_dir=state,
+                                    name="rb")
+        champ = {"path": os.path.abspath(ck_dir), "step": 1,
+                 "metrics": None}
+        self._dead_owner_journal(
+            ctrl, phase=ROLLING_BACK, champion=champ,
+            candidate={"path": os.path.abspath(ck_dir), "step": 2,
+                       "metrics": None})
+        ctrl.close(release=False)
+        ctrl2 = DeploymentController(ck_dir, fleet=stub, state_dir=state,
+                                     name="rb")
+        try:
+            out = ctrl2.run_once()
+            assert out == {"action": "resume_rollback", "step": 2}
+            assert stub.current == (os.path.abspath(ck_dir), 1)
+            assert "2" in ctrl2.quarantined
+            assert ctrl2.champion["step"] == 1
+            # the quarantined step never re-offers
+            assert ctrl2.run_once() == {"action": "idle"}
+        finally:
+            ctrl2.close()
+
+
+@pytest.mark.chaos
+class TestChaosMatrix:
+    """Fault at every pipeline injection point: the journal stays
+    readable, the (stub) fleet is on exactly one of {old, new}
+    champion, and once chaos lifts the conveyor converges."""
+
+    POINTS = ("pipeline.watch", "pipeline.eval", "pipeline.promote")
+
+    def _run(self, tmp_path, rules, cycles=6, eval_data=None):
+        ck_dir = str(tmp_path / "ck")
+        with ShardedModelSaver(ck_dir, sync=True) as s:
+            s.save(_trained_net(), step=1)
+        stub = _StubFleet()
+        chaos.configure(rules)
+        try:
+            ctrl = DeploymentController(
+                ck_dir, fleet=stub, eval_data=eval_data,
+                eval_threshold=0.6, state_dir=str(tmp_path / "state"),
+                poll_interval=0.01, name="matrix")
+            for _ in range(cycles):
+                ctrl.run_once()
+        finally:
+            chaos.deactivate()
+        return ck_dir, stub, ctrl
+
+    @pytest.mark.parametrize("point",
+                             ("pipeline.watch", "pipeline.eval",
+                              "pipeline.promote"))
+    def test_fault_then_converge(self, tmp_path, point):
+        csv = _holdout_csv(tmp_path)
+        ck_dir, stub, ctrl = self._run(
+            tmp_path, [chaos.Rule(point, "error", times=2)],
+            eval_data=csv)
+        try:
+            # faults consumed, conveyor converged to the committed step
+            assert ctrl.champion and ctrl.champion["step"] == 1
+            assert stub.current == (os.path.abspath(ck_dir), 1)
+            assert ctrl.quarantined == {}  # infra faults never verdict
+            journal = ctrl.journal.read()
+            assert journal and not ctrl.journal.torn
+            assert journal["champion"]["step"] == 1
+        finally:
+            ctrl.close()
+
+    @pytest.mark.parametrize("ordinal", list(range(6)))
+    def test_journal_write_faults_never_tear_state(self, tmp_path,
+                                                   ordinal):
+        """A failed journal write at ANY ordinal (write or rename leg)
+        degrades to the previous committed state — never a torn file,
+        and a successor adopts a consistent champion."""
+        csv = _holdout_csv(tmp_path)
+        ck_dir, stub, ctrl = self._run(
+            tmp_path,
+            [chaos.Rule("controller.journal", "error", at=[ordinal])],
+            eval_data=csv)
+        ctrl.close(release=False)
+        assert stub.current == (os.path.abspath(ck_dir), 1)
+        journal = ctrl.journal.read()
+        assert not ctrl.journal.torn
+        if journal is not None:
+            assert journal.get("champion") is None \
+                or journal["champion"]["step"] == 1
+            # make the crashed owner look dead (kill -9 semantics) so
+            # the successor can take the journal over
+            journal["owner"] = {"pid": 2 ** 30, "start_time": 1.0}
+            ctrl.journal.write(journal)
+        # a successor restarts over whatever committed: it must either
+        # adopt the champion or re-discover the step — one champion
+        # either way
+        self_stub = _StubFleet()
+        ctrl2 = DeploymentController(
+            ck_dir, fleet=self_stub, eval_data=csv, eval_threshold=0.6,
+            state_dir=str(tmp_path / "state"), name="matrix")
+        try:
+            for _ in range(3):
+                ctrl2.run_once()
+            assert ctrl2.champion["step"] == 1
+        finally:
+            ctrl2.close()
+
+
+class TestAdmissionConvergence:
+    def test_newcomer_converges_to_champion_before_admission(
+            self, tmp_path):
+        """A replica joining AFTER a promotion (capacity-gap respawn,
+        late attach) must enter rotation on the promoted champion, not
+        whatever it booted with — otherwise later capacity repair tears
+        the promotion across checkpoints."""
+        good = _trained_net()
+        ck_dir = str(tmp_path / "ck")
+        with ShardedModelSaver(ck_dir, sync=True) as s:
+            s.save(good, step=1)
+        boot_dir = str(tmp_path / "boot")
+        with ShardedModelSaver(boot_dir, sync=True) as s:
+            s.save(_net(), step=0)
+        handles, fleet = _fleet(_net(), boot_dir, n=2)
+        ctrl = DeploymentController(ck_dir, fleet=fleet,
+                                    poll_interval=0.01, name="join")
+        late = None
+        try:
+            assert ctrl.run_once()["promoted"]
+            assert fleet.current_step == 1
+            # a latecomer serving the BOOT checkpoint joins the fleet
+            late = serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                                 warmup_shape=(4,),
+                                 checkpoint={"path": boot_dir,
+                                             "step": 0})
+            fleet.attach(late.url)
+            _poll_until_ready(fleet, 3)
+            want = os.path.abspath(ck_dir)
+            snap = fleet.snapshot()
+            assert list(snap["checkpoints_served"]) == [f"{want}@1"]
+            assert len(snap["checkpoints_served"][f"{want}@1"]) == 3
+        finally:
+            if late is not None:
+                late.close()
+            _close(fleet, handles, ctrl)
+
+    def test_fleet_without_promotion_admits_heterogeneous_replicas(
+            self, tmp_path):
+        """Before any rolling_reload pins current_step, admission must
+        NOT rewrite what attached replicas serve — boot-time
+        heterogeneity is the operator's call."""
+        boot_dir = str(tmp_path / "boot")
+        with ShardedModelSaver(boot_dir, sync=True) as s:
+            s.save(_net(), step=0)
+        other_dir = str(tmp_path / "other")
+        with ShardedModelSaver(other_dir, sync=True) as s:
+            s.save(_net(), step=5)
+        h1 = serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           warmup_shape=(4,),
+                           checkpoint={"path": boot_dir, "step": 0})
+        h2 = serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                           warmup_shape=(4,),
+                           checkpoint={"path": other_dir, "step": 5})
+        fleet = Fleet(start=False, heartbeat_timeout=10.0,
+                      initial_checkpoint=boot_dir)
+        try:
+            fleet.attach(h1.url)
+            fleet.attach(h2.url)
+            _poll_until_ready(fleet, 2)
+            assert fleet.current_step is None
+            assert len(fleet.snapshot()["checkpoints_served"]) == 2
+        finally:
+            fleet.close()
+            h1.close()
+            h2.close()
+
+
+class TestWatcherRaces:
+    def test_list_committed_steps_races_rotating_writer(self, tmp_path):
+        """Satellite: the watcher's scan vs the AsyncCheckpointWriter's
+        rotation (prune after every commit). Steps vanish mid-listdir;
+        the scan and discover_latest must skip them, never raise."""
+        root = str(tmp_path / "ck")
+        net = _net()
+        errors = []
+        stop = threading.Event()
+
+        def scan():
+            while not stop.is_set():
+                try:
+                    steps = list_committed_steps(root)
+                    assert steps == sorted(steps)
+                    if steps:
+                        _, latest = discover_latest(root)
+                        assert latest >= steps[0]
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+                    return
+
+        threads = [threading.Thread(target=scan, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        with ShardedModelSaver(root, keep=2, sync=True) as s:
+            for step in range(1, 40):
+                s.save(net, step=step)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert errors == []
+        # at rest: exactly the kept window, newest committed wins
+        assert list_committed_steps(root) == [38, 39]
+        assert discover_latest(root) == (root, 39)
+
+    def test_discover_latest_skips_deleted_step(self, tmp_path):
+        """A step dir deleted between listing and manifest read (GC
+        race) falls back to the next-older committed step instead of
+        raising."""
+        root = str(tmp_path / "ck")
+        with ShardedModelSaver(root, sync=True) as s:
+            s.save(_net(), step=1)
+            s.save(_net(), step=2)
+        # tear step 2's manifest out from under the reader: marker
+        # still present, manifest gone — the mid-GC window
+        os.unlink(os.path.join(root, ckfmt.step_dir_name(2),
+                               ckfmt.MANIFEST))
+        assert list_committed_steps(root) == [1]
+        assert discover_latest(root) == (root, 1)
+
+
+class TestCliSurface:
+    def test_cli_eval_json(self, tmp_path, capsys):
+        from deeplearning4j_tpu.cli import main
+
+        ck_dir = str(tmp_path / "ck")
+        with ShardedModelSaver(ck_dir, sync=True) as s:
+            s.save(_trained_net(), step=3)
+        csv = _holdout_csv(tmp_path)
+        rc = main(["eval", "-m", ck_dir, "--data", csv, "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip())
+        # the same metric shape `test` emits, plus checkpoint identity
+        assert set(out) >= {"f1", "accuracy", "precision", "recall",
+                            "n", "path", "step", "eval_seconds"}
+        assert out["step"] == 3
+        assert out["f1"] >= 0.8
+
+    def test_cli_pipeline_smoke_and_arg_validation(self, tmp_path,
+                                                   capsys):
+        from deeplearning4j_tpu.cli import main
+
+        ck_dir = str(tmp_path / "ck")
+        _commit_step(ck_dir, 1)
+        # exactly one of --fleet-url / --spawn-fleet
+        assert main(["pipeline", "--checkpoint-dir", ck_dir]) == 2
+        assert main(["pipeline", "--checkpoint-dir", ck_dir,
+                     "--spawn-fleet"]) == 2  # needs -m
+        capsys.readouterr()
+        rc = main(["pipeline", "--checkpoint-dir", ck_dir,
+                   "--fleet-url", "http://127.0.0.1:9",
+                   "--state-dir", str(tmp_path / "state"),
+                   "--status-port", "0", "--smoke"])
+        assert rc == 0
+        announce = json.loads(capsys.readouterr().out.splitlines()[0])
+        assert announce["checkpoint_dir"] == os.path.abspath(ck_dir)
+        assert announce["fleet"] == "http://127.0.0.1:9"
+        assert announce["status"].startswith("http://")
+        # the smoke released the journal: a live run can start
+        assert json.load(open(os.path.join(
+            str(tmp_path / "state"), "controller.journal")))["owner"] \
+            is None
