@@ -1161,6 +1161,200 @@ def bench_chaos():
         shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_stream_failover():
+    """Durable-stream failover drill (ISSUE 15, docs/FLEET.md "Stream
+    failover"): SIGKILL one of two replica processes while concurrent
+    /generate streams are mid-flight. The replicas serve a
+    deterministically-initialized transformer (`--transformer SPEC`),
+    so the router's resume — replaying `prompt + delivered` on the
+    survivor — must produce a continuation BIT-IDENTICAL to an
+    uninterrupted reference. Gates: ZERO client-visible stream
+    failures (every stream gapless, duplicate-free, token-for-token
+    equal to the reference), replayed-prefill tokens bounded by
+    prompt+generated per resumed stream (and the survivor's warm
+    prefix cache absorbs the replayed prompt page), and bounded p99
+    time-to-next-token across the hop."""
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
+    from deeplearning4j_tpu.serving.fleet import Fleet, ReplicaSpawner
+    from deeplearning4j_tpu.serving.router import serve_fleet
+    from deeplearning4j_tpu.testing import chaos as chaos_mod
+
+    fast = _fast()
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(4).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=3)
+            .pretrain(False).build())
+    work = tempfile.mkdtemp(prefix="dl4j_bench_failover_")
+    ckpt = os.path.join(work, "failover.ckpt")
+    DefaultModelSaver(ckpt, keep_old=False).save(MultiLayerNetwork(conf))
+    spec = os.path.join(work, "tf.json")
+    with open(spec, "w") as f:
+        _json.dump({"vocab_size": 17, "d_model": 32, "n_heads": 2,
+                    "n_layers": 2, "d_ff": 64, "max_len": 64,
+                    "interpret": fast,  # pallas interpreter off-TPU
+                    "seed": 0}, f)
+    # pace token emission so the SIGKILL lands MID-stream
+    delay_s = 0.02 if fast else 0.03
+    env = dict(os.environ,
+               **chaos_mod.env_spec([chaos_mod.Rule(
+                   "generate.midstream", "delay", delay_s=delay_s)]))
+    spawner = ReplicaSpawner(
+        ckpt, serve_args=["--max-delay-ms", "1", "--transformer", spec,
+                          "--slots", "8", "--page-size", "8"],
+        env=env)
+
+    # prompt fills exactly one KV page: the warm passes seed it into
+    # each replica's prefix cache, so a resumed replay's prefill is a
+    # cache hit instead of recompute
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    n_tokens = 16 if fast else 32
+    n_streams = 4
+    body = _json.dumps({"prompt": [prompt], "max_tokens": n_tokens,
+                        "stream": True}).encode()
+
+    def run_stream(out_events, out_times):
+        req = urllib.request.Request(
+            f"{router.url}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300) as r:
+            for ln in r:
+                if not ln.strip():
+                    continue
+                out_events.append(_json.loads(ln))
+                out_times.append(time.perf_counter())
+
+    def p99(gaps):
+        return (sorted(gaps)[max(0, int(len(gaps) * 0.99) - 1)]
+                if gaps else None)
+
+    fleet = Fleet(spawner=spawner, heartbeat_interval=0.2,
+                  heartbeat_timeout=3.0, breaker_threshold=2,
+                  breaker_reset_s=0.4)
+    router = None
+    try:
+        fleet.spawn(2)
+        fleet.wait_ready(2, timeout=300)
+        router = serve_fleet(fleet)
+
+        # warm passes: compile the decode path AND seed the prompt's
+        # page into both replicas' prefix caches (sequential requests
+        # round-robin across the pair)
+        ref_toks = None
+        calm_gaps = []
+        for _ in range(2):
+            ev, ts = [], []
+            run_stream(ev, ts)
+            toks = [e["token"] for e in ev if "token" in e]
+            assert len(toks) == n_tokens
+            if ref_toks is None:
+                ref_toks = toks
+            assert toks == ref_toks
+            calm_gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+        calm_p99 = p99(calm_gaps)
+
+        # drill: concurrent streams, SIGKILL the busy replica mid-flight
+        all_events = [[] for _ in range(n_streams)]
+        all_times = [[] for _ in range(n_streams)]
+        errors = []
+
+        def worker(i):
+            try:
+                run_stream(all_events[i], all_times[i])
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    daemon=True)
+                   for i in range(n_streams)]
+        for t in threads:
+            t.start()
+        victim = None
+        kill_by = time.monotonic() + 30.0
+        while victim is None and time.monotonic() < kill_by:
+            busy = [r for r in fleet._replicas.values()
+                    if r.outstanding]
+            victim = busy[0] if busy else None
+            time.sleep(0.01)
+        time.sleep(6 * delay_s)          # a few tokens in flight
+        chaos_mod.sigkill(victim.proc)
+        for t in threads:
+            t.join(timeout=300)
+
+        # exactly-once + bit-identical across every stream
+        failures = list(errors)
+        resumes = 0
+        drill_gaps = []
+        for ev, ts in zip(all_events, all_times):
+            toks = [e for e in ev if "token" in e]
+            if [e["token_index"] for e in toks] != list(range(n_tokens)):
+                failures.append("token_index gap/dup")
+            if [e["token"] for e in toks] != ref_toks:
+                failures.append("tokens diverged from reference")
+            if not (ev and ev[-1].get("done")):
+                failures.append("stream ended without done")
+            else:
+                resumes += ev[-1]["resumes"]
+            drill_gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+        dp99 = p99(drill_gaps)
+        bound = max(20 * calm_p99, 5.0) if calm_p99 else 5.0
+
+        snap = fleet.snapshot()
+        survivor = next(r for r in fleet._replicas.values()
+                        if r.id != victim.id)
+        sdec = survivor.client.stats()["generate"]["decode"]
+        # replay budget: each resumed stream replays at most its
+        # prompt + everything generated so far
+        replay_budget = n_streams * (len(prompt) + n_tokens)
+        return {
+            "value": round(dp99 * 1e3, 2) if dp99 else None,
+            "unit": "p99_time_to_next_token_ms",
+            "lower_is_better": True,
+            "streams": n_streams,
+            "tokens_per_stream": n_tokens,
+            "stream_failures": len(failures),
+            "failure_sample": failures[:3],
+            "resumes": resumes,
+            "fleet_stream_resumes": snap["stream_resumes"],
+            "tokens_replayed": snap["stream_tokens_replayed"],
+            "tokens_deduped": snap["stream_tokens_deduped"],
+            "replay_budget_tokens": replay_budget,
+            "survivor_prefix_hits": sdec["prefix_cache"]["hits"],
+            "survivor_decode_programs": sdec["decode_step_programs"],
+            "calm_p99_ttnt_ms": (round(calm_p99 * 1e3, 2)
+                                 if calm_p99 else None),
+            "drill_p99_ttnt_ms": (round(dp99 * 1e3, 2)
+                                  if dp99 else None),
+            "p99_bound_ms": round(bound * 1e3, 2),
+            "gate_zero_stream_failures": not failures,
+            "gate_resumed": snap["stream_resumes"] >= 1,
+            "gate_replay_bounded": (
+                0 < snap["stream_tokens_replayed"] <= replay_budget),
+            "gate_warm_replay_prefix_hits":
+                sdec["prefix_cache"]["hits"] >= 1,
+            "gate_p99_ttnt_bounded": bool(dp99 and dp99 <= bound),
+            "gate_one_decode_program":
+                sdec["decode_step_programs"] == 1,
+        }
+    finally:
+        if router is not None:
+            router.close(stop_replicas=True)
+        else:
+            fleet.close(stop_replicas=True)
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_train_elastic():
     """Self-healing elastic training drills (ISSUE 9,
     docs/FAULT_TOLERANCE.md "Supervisor runbook"). Three drills over a
@@ -2420,6 +2614,7 @@ CONFIGS = {
     "prefix_cache": bench_prefix_cache,
     "fleet": bench_fleet,
     "chaos": bench_chaos,
+    "stream_failover": bench_stream_failover,
     "train_elastic": bench_train_elastic,
     "controlplane": bench_controlplane,
     "pipeline": bench_pipeline,
@@ -2442,6 +2637,7 @@ METRIC_NAMES = {
     "prefix_cache": "serving_prefix_cache_prefill_token_reduction",
     "fleet": "fleet_predict_rows_per_sec_4_replicas",
     "chaos": "chaos_sigstop_breaker_eviction_s",
+    "stream_failover": "serving_stream_failover_p99_ttnt_ms",
     "train_elastic": "train_elastic_kill_recovery_s",
     "controlplane": "controlplane_router_restart_recovery_s",
     "pipeline": "pipeline_commit_to_served_s",

@@ -85,13 +85,22 @@ def discover_latest(root: str) -> Tuple[str, int]:
     # Newest-first, re-verifying each candidate's manifest is still
     # readable: a concurrent writer's prune() can delete a step between
     # our listdir and the manifest read — fall back to the next-older
-    # committed step instead of raising.
-    for step in reversed(list_committed_steps(root)):
-        try:
-            ckfmt.read_manifest(root, step)
-        except (ckfmt.CheckpointError, OSError, ValueError):
-            continue
-        return root, step
+    # committed step instead of raising. A rotating writer can even
+    # blank the WHOLE snapshot (the newest step uncommitted at listdir
+    # time, every older candidate pruned before its manifest read), so
+    # a lost race re-scans before it is allowed to mean "nothing ever
+    # committed" — prune only runs AFTER a newer commit, so the rescan
+    # is guaranteed to see that newer committed step.
+    for _ in range(3):
+        steps = list_committed_steps(root)
+        for step in reversed(steps):
+            try:
+                ckfmt.read_manifest(root, step)
+            except (ckfmt.CheckpointError, OSError, ValueError):
+                continue
+            return root, step
+        if not steps and not ckfmt.list_steps(root, committed_only=False):
+            break  # truly empty root — not a race
     torn = ckfmt.list_steps(root, committed_only=False)
     if torn:
         raise ckfmt.CheckpointError(

@@ -73,6 +73,33 @@ def _load_model(path: str):
     return net
 
 
+def _transformer_engine(spec: str):
+    """Build a /generate engine from a `--transformer SPEC`: a JSON
+    object (inline or a file path) of TransformerConfig overrides plus
+    an optional "seed". Initialization is a pure function of
+    (seed, config), so every process launched with the same SPEC serves
+    bit-identical weights — the property the fleet's stream failover
+    leans on: a greedy decode resumed on a survivor continues exactly
+    where the dead replica stopped (docs/FLEET.md "Stream failover")."""
+    import jax
+
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig, init_transformer_params)
+    from deeplearning4j_tpu.serving import InferenceEngine
+
+    raw = spec
+    if os.path.exists(spec):
+        with open(spec) as f:
+            raw = f.read()
+    fields = json.loads(raw)
+    if not isinstance(fields, dict):
+        raise ValueError("--transformer SPEC must be a JSON object")
+    seed = int(fields.pop("seed", 0))
+    cfg = TransformerConfig(**fields)
+    params = init_transformer_params(jax.random.PRNGKey(seed), cfg)
+    return InferenceEngine.for_transformer(params, cfg)
+
+
 def _model_n_out(net) -> Optional[int]:
     try:
         return net.conf.confs[-1].n_out or None
@@ -396,8 +423,10 @@ def cmd_serve(args) -> int:
             ck = {"path": os.path.abspath(args.model), "step": ck_step}
         elif not args.model.endswith(".json"):
             ck = {"path": os.path.abspath(args.model), "step": None}
+        gen = (_transformer_engine(args.transformer)
+               if args.transformer else None)
         handle = serve_network(
-            net, checkpoint=ck,
+            net, checkpoint=ck, generate_engine=gen,
             host=args.host, port=args.port, n_replicas=args.replicas,
             max_batch_size=args.max_batch_size,
             max_delay_ms=args.max_delay_ms,
@@ -463,6 +492,7 @@ def cmd_fleet(args) -> int:
                   shed_high_water=args.shed_high_water,
                   request_timeout=args.request_timeout,
                   retry_budget=args.retry_budget,
+                  stream_resume_attempts=args.stream_resume_attempts,
                   breaker_threshold=args.breaker_threshold,
                   breaker_reset_s=args.breaker_reset,
                   autoscaler=autoscaler,
@@ -940,6 +970,15 @@ def build_parser() -> argparse.ArgumentParser:
                               "gather materializes the dense window; "
                               "auto picks pallas on TPU inside its "
                               "envelope (docs/SERVING.md)")
+    p_serve.add_argument("--transformer", default=None, metavar="SPEC",
+                         help="enable /generate from a deterministically "
+                              "initialized transformer: SPEC is a JSON "
+                              "object (inline or a file path) of "
+                              "TransformerConfig fields plus an optional "
+                              "\"seed\" — every process given the same "
+                              "SPEC serves bit-identical weights, which "
+                              "is how fleet stream-failover drills get "
+                              "interchangeable replicas (docs/FLEET.md)")
     p_serve.add_argument("--no-warmup", dest="warmup",
                          action="store_false",
                          help="skip precompiling the bucket programs")
@@ -989,6 +1028,12 @@ def build_parser() -> argparse.ArgumentParser:
     p_fleet.add_argument("--retry-budget", type=int, default=2,
                          help="max /predict retries on healthy peers "
                               "after a replica failure or timeout")
+    p_fleet.add_argument("--stream-resume-attempts", type=int, default=2,
+                         help="max mid-stream failover resumes per "
+                              "/generate before the router gives up "
+                              "with the in-band retryable error "
+                              "(0 disables durable-stream failover; "
+                              "docs/FLEET.md \"Stream failover\")")
     p_fleet.add_argument("--breaker-threshold", type=int, default=3,
                          help="consecutive request timeouts that trip a "
                               "replica's circuit breaker open (evicting "

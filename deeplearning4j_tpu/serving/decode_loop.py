@@ -154,6 +154,13 @@ class GenerationStream:
         #: False = this request neither matches nor seeds the shared
         #: prefix cache (set by submit_many's per-request opt-out)
         self.prefix_cache = True
+        #: absolute index of the FIRST token this stream will emit —
+        #: non-zero when the request is a failover continuation whose
+        #: already-delivered tokens ride in as prompt context. The
+        #: streaming front end adds it to each emitted token's
+        #: `token_index`, which is the router's exactly-once dedupe key
+        #: (docs/SERVING.md "Streaming", docs/FLEET.md failover)
+        self.token_index_base = 0
         self.finish_reason: Optional[str] = None
         self.error: Optional[BaseException] = None
         self._generated: List[int] = []
@@ -191,6 +198,16 @@ class GenerationStream:
                     raise self.error
                 return
             yield item
+
+    def indexed_tokens(self, timeout: Optional[float] = None
+                       ) -> Iterator[tuple]:
+        """`tokens()` with each token's ABSOLUTE index attached:
+        yields `(token_index_base + n, token)` for the n-th emitted
+        token. The streaming HTTP front end relays the index on every
+        NDJSON chunk so a resuming router can deduplicate replayed
+        tokens by position (exactly-once delivery across failover)."""
+        for n, tok in enumerate(self.tokens(timeout=timeout)):
+            yield self.token_index_base + n, tok
 
     def __iter__(self) -> Iterator[int]:
         return self.tokens()
@@ -478,6 +495,18 @@ class DecodeLoop:
             self._thread.start()
 
     # ----------------------------------------------------- public API
+    @staticmethod
+    def _per_row(value, n_rows: int, name: str) -> List[int]:
+        """Normalize a scalar-or-per-row int parameter to one int per
+        row (submit_many's max_tokens / token_index_base contract)."""
+        if isinstance(value, (list, tuple, np.ndarray)):
+            if len(value) != n_rows:
+                raise ValueError(
+                    f"per-row {name} needs {n_rows} entries, "
+                    f"got {len(value)}")
+            return [int(v) for v in value]
+        return [int(value)] * n_rows
+
     def validate(self, prompt, max_tokens: int) -> np.ndarray:
         """Check one request without enqueueing it (raises ValueError);
         returns the normalized 1-D prompt. Callers submitting several
@@ -514,10 +543,11 @@ class DecodeLoop:
                                 deadline=deadline,
                                 prefix_cache=prefix_cache)[0]
 
-    def submit_many(self, prompts, max_tokens: int,
+    def submit_many(self, prompts, max_tokens,
                     eos_id: Optional[int] = None,
                     deadline: Optional[Deadline] = None,
-                    prefix_cache: bool = True
+                    prefix_cache: bool = True,
+                    token_index_base=0
                     ) -> List[GenerationStream]:
         """Admit several rows as ONE unit: all rows enqueue or none do.
         A shed that fired between a multi-row request's submits would
@@ -525,18 +555,32 @@ class DecodeLoop:
         consumer ever reads them), so the /generate handler routes
         every multi-row body through here. An already-expired `deadline`
         sheds the whole group here; one that expires while queued sheds
-        at admission — either way before any prefill compute."""
+        at admission — either way before any prefill compute.
+
+        `max_tokens` and `token_index_base` accept either one scalar
+        for every row or a per-row sequence (length == len(prompts)).
+        Per-row budgets are what a failover continuation needs: rows
+        interrupted at different depths re-admit as one group, each
+        with its own remaining budget and absolute-index offset."""
         if deadline is not None and deadline.expired:
             self._m_deadline.inc()
             deadline.check("decode admission")  # raises
-        prompts = [self.validate(p, max_tokens) for p in prompts]
-        streams = [GenerationStream(p, max_tokens, eos_id,
-                                    deadline=deadline)
-                   for p in prompts]
+        per_row_max = self._per_row(max_tokens, len(prompts),
+                                    "max_tokens")
+        per_row_base = self._per_row(token_index_base, len(prompts),
+                                     "token_index_base")
+        prompts = [self.validate(p, mt)
+                   for p, mt in zip(prompts, per_row_max)]
+        streams = [GenerationStream(p, mt, eos_id, deadline=deadline)
+                   for p, mt in zip(prompts, per_row_max)]
         loop_ref = weakref.ref(self)
-        for stream in streams:
+        for stream, base in zip(streams, per_row_base):
             stream._loop_ref = loop_ref
             stream.prefix_cache = bool(prefix_cache)
+            if base < 0:
+                raise ValueError(
+                    f"token_index_base must be >= 0, got {base}")
+            stream.token_index_base = base
         with self._cond:
             if self._closed:
                 raise RuntimeError("decode loop is closed")

@@ -30,7 +30,7 @@ from typing import Optional
 
 __all__ = ["OverloadedError", "overload_body",
            "Deadline", "DeadlineExceededError", "deadline_body",
-           "DEADLINE_HEADER"]
+           "DEADLINE_HEADER", "replica_failed_body"]
 
 #: the wire header carrying the REMAINING budget in milliseconds; each
 #: forwarding hop rewrites it smaller (never larger)
@@ -79,6 +79,25 @@ def deadline_body(exc: DeadlineExceededError) -> dict:
         out["deadline_ms"] = exc.deadline_ms
     if exc.elapsed_ms is not None:
         out["elapsed_ms"] = exc.elapsed_ms
+    return out
+
+
+def replica_failed_body(replica_id, detail: str,
+                        resume_attempts: Optional[int] = None) -> dict:
+    """The structured shape every router-side replica failure speaks —
+    as a 502 body when no byte reached the client, or as the final
+    in-band NDJSON line of an already-started stream. Always
+    `retryable`: the request itself is sound, only its placement
+    failed. `resume_attempts` records how many failover resumes the
+    router burned before giving up (docs/FLEET.md "Stream failover"),
+    so a client can distinguish "never placed" from "resumed N times
+    and the fleet still could not finish it"."""
+    out = {"error": "replica_failed",
+           "replica": replica_id,
+           "detail": detail,
+           "retryable": True}
+    if resume_attempts is not None:
+        out["resume_attempts"] = int(resume_attempts)
     return out
 
 

@@ -424,6 +424,7 @@ class Fleet:
                  request_timeout: float = 60.0,
                  generate_timeout: float = 300.0,
                  retry_budget: int = 2,
+                 stream_resume_attempts: int = 2,
                  breaker_threshold: int = 3,
                  breaker_reset_s: Optional[float] = None,
                  autoscaler: Optional[Autoscaler] = None,
@@ -447,6 +448,12 @@ class Fleet:
         #: on peers after a failure; deadline budgets are split across
         #: the attempts this allows
         self.retry_budget = max(0, int(retry_budget))
+        #: mid-stream /generate failovers the router may attempt per
+        #: client request: each resume re-admits the interrupted rows
+        #: on a surviving replica with `prompt + delivered tokens` as
+        #: the continuation context (docs/FLEET.md "Stream failover");
+        #: 0 restores the pre-failover fail-fast behavior
+        self.stream_resume_attempts = max(0, int(stream_resume_attempts))
         self.breaker_threshold = int(breaker_threshold)
         #: open -> half_open wait; default: a few monitor passes
         self.breaker_reset_s = (float(breaker_reset_s)
@@ -533,6 +540,28 @@ class Fleet:
                 "requests shed at the router because their deadline "
                 "budget was already spent").labels(route=route, **lab)
             for route in ("predict", "generate")}
+        self._m_stream_resumes = reg.counter(
+            "dl4j_fleet_stream_resumes",
+            "mid-stream /generate failovers re-admitted on a "
+            "surviving replica (prompt + delivered tokens replayed "
+            "as the continuation context)").labels(**lab)
+        self._m_stream_resume_failures = reg.counter(
+            "dl4j_fleet_stream_resume_failures",
+            "generate streams the router could NOT resume (attempts "
+            "or deadline budget exhausted, or no surviving replica) "
+            "— the client saw the in-band retryable error").labels(
+                **lab)
+        self._m_stream_tokens_replayed = reg.counter(
+            "dl4j_fleet_stream_tokens_replayed",
+            "context tokens (prompt + already-delivered) re-submitted "
+            "as prefill during stream failover — the prefix cache "
+            "turns these into page-reference hits on the "
+            "survivor").labels(**lab)
+        self._m_stream_tokens_deduped = reg.counter(
+            "dl4j_fleet_stream_tokens_deduped",
+            "replayed tokens the router suppressed by absolute "
+            "token_index so the client stream stays exactly-once "
+            "across failover").labels(**lab)
         self._m_timeouts = reg.counter(
             "dl4j_fleet_request_timeouts",
             "request-path timeouts (the circuit breaker's input — a "
@@ -1467,6 +1496,14 @@ class Fleet:
             "requests": {route: int(c.value)
                          for route, c in self._m_requests.items()},
             "retries": int(self._m_retries.value),
+            "stream_resume_attempts": self.stream_resume_attempts,
+            "stream_resumes": int(self._m_stream_resumes.value),
+            "stream_resume_failures": int(
+                self._m_stream_resume_failures.value),
+            "stream_tokens_replayed": int(
+                self._m_stream_tokens_replayed.value),
+            "stream_tokens_deduped": int(
+                self._m_stream_tokens_deduped.value),
             "request_timeouts": int(self._m_timeouts.value),
             "breaker_opens": int(self._m_breaker_opens.value),
             "deadline_exceeded": {route: int(c.value)

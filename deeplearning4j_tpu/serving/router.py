@@ -16,13 +16,25 @@ Two pieces live here (the fleet state machine itself is
     failures and replica 5xx retry transparently on a healthy peer
     (idempotent, so at-least-once is safe); total-outstanding past the
     fleet's high-water mark sheds with 503 + Retry-After.
-  - ``POST /generate`` — one ready replica, streamed straight through
-    (chunked NDJSON passthrough). NOT retried: a generate is expensive
-    and the stream may already be partially delivered — failures
-    before the first byte answer 502 with a structured
-    ``{"error": "replica_failed", "replica": ..., "retryable": true}``;
-    failures mid-stream emit the same error object in-band as the
-    final NDJSON line.
+  - ``POST /generate`` — DURABLE streams (docs/FLEET.md "Stream
+    failover"): the router always drives the replica in streaming mode
+    and keeps a per-stream continuation record — the request spec plus
+    every token already relayed per row. When the serving replica
+    dies, is breaker-evicted, or resets mid-stream, the router
+    re-admits the unfinished rows on a surviving READY replica by
+    submitting ``prompt + tokens-delivered-so-far`` as the new context
+    (the prefix cache makes the replay prefill near-free; greedy
+    argmax decode makes the continuation bit-identical) and resumes
+    relaying from the first undelivered token, deduplicating by
+    absolute ``token_index`` — the client sees every token exactly
+    once. Resumes are bounded (``Fleet(stream_resume_attempts=)``) and
+    budget-aware (the remaining ``X-Deadline-Ms`` shrinks across
+    hops); exhaustion answers 502 with a structured
+    ``{"error": "replica_failed", ..., "retryable": true,
+    "resume_attempts": N}`` before the first byte, or the same object
+    in-band as the final NDJSON line after it. Bodies the router can't
+    parse into a continuation record degrade to the legacy blind
+    passthrough (no resume).
   - ``POST /reload``   — rolling/canary reload across the fleet
     (drain -> per-replica /reload -> /readyz probe -> readmit, one at
     a time; automatic rollback when the canary fails — Fleet.rolling_reload).
@@ -50,12 +62,78 @@ from deeplearning4j_tpu.serving.errors import (DEADLINE_HEADER, Deadline,
                                                DeadlineExceededError,
                                                OverloadedError,
                                                deadline_body,
-                                               overload_body)
+                                               overload_body,
+                                               replica_failed_body)
 from deeplearning4j_tpu.telemetry import exposition
 from deeplearning4j_tpu.testing import chaos
 from deeplearning4j_tpu.utils.httpd import ServerHandle, start_http_server
 
 __all__ = ["ReplicaClient", "FleetHandle", "serve_fleet"]
+
+
+class _ClientGone(Exception):
+    """The DOWNSTREAM client hung up mid-stream. Never attributed to
+    the replica (a client closing its laptop must not evict a healthy
+    replica) — the router just stops relaying and lets the replica-side
+    connection close cancel the slots."""
+
+
+class _RowState:
+    """One row of a /generate continuation record: the original spec
+    plus every token already relayed to the client. `prompt +
+    delivered` is the replay context a resume submits; `len(delivered)`
+    is both the next absolute token_index expected (the exactly-once
+    dedupe key) and the amount to subtract from max_tokens on
+    re-admission."""
+
+    __slots__ = ("index", "prompt", "max_tokens", "delivered",
+                 "finish_reason")
+
+    def __init__(self, index: int, prompt, max_tokens: int):
+        self.index = index            # row position in the CLIENT's request
+        self.prompt = prompt          # original prompt token ids
+        self.max_tokens = max_tokens  # original per-row budget
+        self.delivered = []           # tokens already relayed, in order
+        self.finish_reason = None     # set -> row is terminal
+
+
+def _parse_continuation(data: dict):
+    """Build the per-stream continuation record the failover engine
+    keeps, or return None when the body doesn't speak the decode-loop
+    contract (the router then degrades to the legacy blind passthrough
+    and the replica's own validation answers). Returns
+    (rows, eos_id, prefix_cache)."""
+    try:
+        raw = data["prompt"]
+        if not isinstance(raw, list) or not raw:
+            return None
+        if not isinstance(raw[0], list):
+            raw = [raw]
+        prompts = []
+        for row in raw:
+            if not isinstance(row, list) or not row:
+                return None
+            prompts.append([int(t) for t in row])
+        mt = data.get("max_tokens", data.get("n_tokens", 16))
+        if isinstance(mt, list):
+            if len(mt) != len(prompts):
+                return None
+            per_row = [int(m) for m in mt]
+        else:
+            per_row = [int(mt)] * len(prompts)
+        if any(m < 1 for m in per_row):
+            return None
+        if "token_index_base" in data:
+            # the router OWNS the dedupe offsets; a client already
+            # speaking them is itself a resuming router — pass through
+            return None
+        eos = data.get("eos_id")
+        eos = None if eos is None else int(eos)
+        rows = [_RowState(i, p, m)
+                for i, (p, m) in enumerate(zip(prompts, per_row))]
+        return rows, eos, bool(data.get("prefix_cache", True))
+    except (TypeError, ValueError, KeyError):
+        return None
 
 
 class ReplicaClient:
@@ -295,6 +373,23 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             self.end_headers()
             self.wfile.write(data)
 
+        def _hop_budget(self, deadline):
+            """Per-attempt (timeout, forwarded-headers, breaker-
+            eligible) derived from the REMAINING budget — recomputed on
+            every resume hop so the forwarded `X-Deadline-Ms` only ever
+            shrinks. A timeout at a deadline-sliced window shorter than
+            a fair wait says the CLIENT was impatient, not that the
+            replica hung — same eligibility rule forward_predict
+            applies (fleet.note_request_failure's contract)."""
+            if deadline is None:
+                hop_timeout, fwd_headers = fleet.generate_timeout, None
+            else:
+                hop_timeout = deadline.timeout(fleet.generate_timeout)
+                fwd_headers = {DEADLINE_HEADER: deadline.header_value()}
+            eligible = hop_timeout >= min(fleet.generate_timeout,
+                                          fleet.probe_timeout)
+            return hop_timeout, fwd_headers, eligible
+
         def _generate(self):
             data = self._read_json()  # parsed for stream/deadline
             streaming = bool(data.get("stream", False))
@@ -302,41 +397,369 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             if deadline is not None and deadline.expired:
                 fleet._m_deadline["generate"].inc()
                 deadline.check("router dispatch")  # raises -> 504
-            replica = fleet.select(route="generate")
+            parsed = _parse_continuation(data)
             start = time.perf_counter()
+            try:
+                if parsed is None:
+                    self._generate_passthrough(streaming, deadline)
+                else:
+                    self._generate_durable(parsed, streaming, deadline)
+            except _ClientGone:
+                self.close_connection = True
+            finally:
+                fleet.observe("generate", time.perf_counter() - start)
+
+        def _generate_durable(self, parsed, streaming, deadline):
+            """Failover-durable /generate: drive the replica in
+            streaming mode (even for a non-streaming client), fold its
+            NDJSON into the continuation record, and on replica failure
+            re-admit the unfinished rows on a survivor with
+            `prompt + delivered` as the new context. The client's
+            response headers are sent LAZILY — while no byte has been
+            relayed, a total failure can still answer a clean 502."""
+            import http.client as _hc
+
+            rows, eos_id, use_prefix = parsed
+            replica_errs = (OSError, _hc.HTTPException)
+            failed = []        # replica ids excluded from resume placement
+            resumes = 0        # successful re-admissions (stream opened)
+            resume_tried = 0   # resume attempts started (reported on fail)
+            state = {"headers_sent": False}
+
+            def chunk(obj: dict) -> None:
+                # lazy headers: the first relayed line commits us to the
+                # in-band error contract; before it, status codes work
+                try:
+                    if not state["headers_sent"]:
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/x-ndjson")
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        state["headers_sent"] = True
+                    raw = (json.dumps(obj) + "\n").encode()
+                    self.wfile.write(f"{len(raw):x}\r\n".encode()
+                                     + raw + b"\r\n")
+                    self.wfile.flush()
+                except _ClientGone:
+                    raise
+                except Exception as e:
+                    raise _ClientGone(str(e)) from e
+
+            def end_chunked() -> None:
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except Exception:
+                    pass
+                self.close_connection = True
+
+            def reply_complete() -> None:
+                reasons = [r.finish_reason for r in rows]
+                toks = [r.prompt + r.delivered
+                        if r.finish_reason not in ("error",
+                                                   "deadline_exceeded")
+                        else None
+                        for r in rows]
+                if streaming:
+                    chunk({"done": True, "tokens": toks,
+                           "finish_reasons": reasons,
+                           "resumes": resumes})
+                    end_chunked()
+                elif "deadline_exceeded" in reasons:
+                    self._reply(504, {"error": "deadline_exceeded",
+                                      "detail": "generation deadline "
+                                      "exceeded on the replica",
+                                      "finish_reasons": reasons})
+                elif "error" in reasons:
+                    self._reply(500, {"error": "generation failed",
+                                      "finish_reasons": reasons})
+                else:
+                    out = {"tokens": toks, "finish_reasons": reasons}
+                    if resumes:
+                        out["resumes"] = resumes
+                    self._reply(200, out)
+
+            def reply_inband(obj: dict) -> None:
+                # the replica spoke a terminal in-band error (deadline,
+                # chaos reset already surfaced as JSON, ...): relay its
+                # shape, NOT a replica failure
+                if streaming:
+                    chunk(obj)
+                    end_chunked()
+                elif obj.get("error") == "deadline_exceeded":
+                    self._reply(504, obj)
+                else:
+                    self._reply(500, obj)
+
+            def reply_failed(replica_id, detail: str) -> None:
+                # resume budget exhausted (attempts or deadline): the
+                # in-band retryable fallback, now carrying how many
+                # resumes were burned
+                fleet._m_stream_resume_failures.inc()
+                body = replica_failed_body(replica_id, detail,
+                                           resume_attempts=resume_tried)
+                if state["headers_sent"]:
+                    chunk(body)
+                    end_chunked()
+                else:
+                    self._reply(502, body)
+
+            attempt = 0
+            last = (None, "no replica attempted")  # (id, detail)
+            while True:
+                pending = [r for r in rows if r.finish_reason is None]
+                if not pending:
+                    reply_complete()
+                    return
+                if attempt > 0:
+                    # ---------------- a failover resume: bounded + budget-aware
+                    if attempt > fleet.stream_resume_attempts:
+                        reply_failed(*last)
+                        return
+                    if deadline is not None and deadline.expired:
+                        reply_failed(last[0], f"{last[1]} (deadline "
+                                     "spent before resume)")
+                        return
+                    resume_tried += 1
+                    try:
+                        chaos.hit("router.stream_resume",
+                                  attempt=attempt, replica=last[0])
+                    except Exception as e:
+                        last = (last[0], f"resume blocked: "
+                                f"{type(e).__name__}: {e}")
+                        attempt += 1
+                        continue
+                    try:
+                        replica = fleet.select(route="generate",
+                                               exclude=tuple(failed))
+                    except (NoReadyReplicas, OverloadedError) as e:
+                        reply_failed(last[0], f"{last[1]}; no surviving "
+                                     f"replica to resume on ({e})")
+                        return
+                else:
+                    replica = fleet.select(route="generate")
+                hop_timeout, fwd_headers, eligible = \
+                    self._hop_budget(deadline)
+                body = {
+                    # replay context: everything the client already has
+                    "prompt": [r.prompt + r.delivered for r in pending],
+                    "max_tokens": [r.max_tokens - len(r.delivered)
+                                   for r in pending],
+                    "stream": True,
+                    "prefix_cache": use_prefix,
+                    # absolute indices resume where delivery stopped, so
+                    # dedupe below is a pure integer comparison
+                    "token_index_base": [len(r.delivered)
+                                         for r in pending],
+                }
+                if eos_id is not None:
+                    body["eos_id"] = eos_id
+                replayed = sum(len(r.prompt) + len(r.delivered)
+                               for r in pending)
+                conn = None
+                try:
+                    try:
+                        conn, resp = replica.client.open(
+                            "POST", "/generate",
+                            json.dumps(body).encode(),
+                            timeout=hop_timeout, headers=fwd_headers)
+                    except replica_errs as e:
+                        fleet.note_request_failure(
+                            replica, e, breaker_eligible=eligible)
+                        failed.append(replica.id)
+                        last = (replica.id, f"{type(e).__name__}: {e}")
+                        attempt += 1
+                        continue
+                    if resp.status != 200:
+                        raw = resp.read()
+                        if attempt > 0:
+                            # a survivor refusing the resume (shedding,
+                            # validation): exclude it and keep going
+                            failed.append(replica.id)
+                            last = (replica.id,
+                                    f"resume refused: HTTP {resp.status}")
+                            attempt += 1
+                            continue
+                        fleet.note_request_success(replica)
+                        if resp.status == 400:
+                            # the replica rejected the streaming upgrade
+                            # (no decode loop): nothing was delivered
+                            # yet, so forward the ORIGINAL body untouched
+                            # and relay whatever the replica says
+                            self._relay_plain(replica, hop_timeout,
+                                              fwd_headers, eligible)
+                            return
+                        extra = []
+                        ra = resp.getheader("Retry-After")
+                        if ra:
+                            extra.append(("Retry-After", ra))
+                        ctype = resp.getheader("Content-Type",
+                                               "application/json")
+                        self.send_response(resp.status)
+                        self.send_header("Content-Type", ctype)
+                        for k, v in extra:
+                            self.send_header(k, v)
+                        self.send_header("Content-Length", str(len(raw)))
+                        self.end_headers()
+                        self.wfile.write(raw)
+                        return
+                    if attempt > 0:
+                        resumes += 1
+                        fleet._m_stream_resumes.inc()
+                        fleet._m_stream_tokens_replayed.inc(replayed)
+                    kind, payload = self._relay_continuation(
+                        resp, pending, eos_id,
+                        chunk if streaming else None)
+                    if kind == "broken":
+                        fleet.note_request_failure(
+                            replica, payload, breaker_eligible=eligible)
+                        failed.append(replica.id)
+                        last = (replica.id,
+                                f"{type(payload).__name__}: {payload}")
+                        attempt += 1
+                        continue
+                    fleet.note_request_success(replica)
+                    if kind == "inband":
+                        reply_inband(payload)
+                        return
+                    # kind == "done": loop re-checks pending (empty
+                    # unless the replica under-reported — it won't)
+                finally:
+                    if conn is not None:
+                        conn.close()
+                    fleet.release(replica)
+
+        def _relay_continuation(self, resp, pending, eos_id, emit):
+            """Fold one replica's NDJSON stream into the continuation
+            record, relaying token chunks via `emit` (None buffers for
+            a non-streaming client). Returns:
+
+            - ("done", None)    — the replica finished every row;
+            - ("inband", obj)   — terminal in-band error object
+              (deadline and friends — NOT a replica failure);
+            - ("broken", exc)   — the replica died / hung / broke the
+              protocol mid-stream; the caller resumes elsewhere.
+
+            Exactly-once is enforced HERE: every token chunk carries an
+            absolute `token_index`; anything below the next expected
+            index was already relayed before the failover and is
+            dropped (deduped), a gap above it means lost tokens and is
+            treated as a replica failure so the resume replays them."""
+            try:
+                while True:
+                    line = resp.readline()  # http.client de-chunks
+                    if not line:
+                        return ("broken", ConnectionError(
+                            "replica stream ended without a done line"))
+                    if not line.endswith(b"\n"):
+                        return ("broken", ConnectionError(
+                            "replica stream died mid-line"))
+                    if not line.strip():
+                        continue
+                    try:
+                        obj = json.loads(line)
+                    except ValueError:
+                        return ("broken", ConnectionError(
+                            "undecodable stream line from replica"))
+                    if obj.get("done"):
+                        reasons = obj.get("finish_reasons") or []
+                        for li, row in enumerate(pending):
+                            if row.finish_reason is None:
+                                row.finish_reason = (
+                                    reasons[li] if li < len(reasons)
+                                    else "error")
+                        return ("done", None)
+                    if "token" in obj:
+                        li = obj.get("row", 0)
+                        if not isinstance(li, int) \
+                                or not 0 <= li < len(pending):
+                            return ("broken", ConnectionError(
+                                f"stream row {li!r} out of range"))
+                        row = pending[li]
+                        expected = len(row.delivered)
+                        idx = int(obj.get("token_index", expected))
+                        if idx < expected:
+                            # a replayed token the client already has
+                            fleet._m_stream_tokens_deduped.inc()
+                            continue
+                        if idx > expected:
+                            return ("broken", ConnectionError(
+                                f"token index gap (got {idx}, "
+                                f"expected {expected})"))
+                        tok = int(obj["token"])
+                        row.delivered.append(tok)
+                        if eos_id is not None and tok == eos_id:
+                            row.finish_reason = "eos"
+                        elif len(row.delivered) >= row.max_tokens:
+                            row.finish_reason = "max_tokens"
+                        if emit is not None:
+                            # rewrite to the CLIENT's row numbering
+                            emit({"row": row.index, "token": tok,
+                                  "token_index": idx})
+                        continue
+                    if "error" in obj:
+                        return ("inband", obj)
+                    # unknown line shape: tolerate (forward-compat)
+            except _ClientGone:
+                raise
+            except Exception as e:
+                return ("broken", e)
+
+        def _relay_plain(self, replica, hop_timeout, fwd_headers,
+                         eligible) -> None:
+            """Re-forward the client's ORIGINAL body to `replica` and
+            relay the whole reply — the legacy escape hatch when the
+            replica rejected the router's streaming upgrade (a serve
+            process without a decode loop still answers plain
+            /generate)."""
+            import http.client as _hc
+
+            try:
+                status, headers, data = replica.client.request(
+                    "POST", "/generate", self._body,
+                    timeout=hop_timeout, headers=fwd_headers)
+            except (OSError, _hc.HTTPException) as e:
+                fleet.note_request_failure(replica, e,
+                                           breaker_eligible=eligible)
+                self._reply(502, replica_failed_body(
+                    replica.id, f"{type(e).__name__}: {e}"))
+                return
+            if status < 500:
+                fleet.note_request_success(replica)
+            extra = [("Retry-After", headers["Retry-After"])] \
+                if "Retry-After" in headers else []
+            ctype = headers.get("Content-Type", "application/json")
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            for k, v in extra:
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _generate_passthrough(self, streaming, deadline):
+            """The pre-failover path, kept for bodies that don't parse
+            into a continuation record (string prompts, exotic fields,
+            a client that is itself a resuming router): one replica,
+            blind relay, no resume."""
+            replica = fleet.select(route="generate")
             import http.client as _hc
 
             replica_errs = (OSError, _hc.HTTPException)
             try:
-                if deadline is None:
-                    hop_timeout, fwd_headers = fleet.generate_timeout, None
-                else:
-                    # generate is never replayed, so the whole remaining
-                    # budget rides this one hop
-                    hop_timeout = deadline.timeout(fleet.generate_timeout)
-                    fwd_headers = {DEADLINE_HEADER:
-                                   deadline.header_value()}
-                # a timeout at a deadline-sliced window shorter than a
-                # fair wait says the CLIENT was impatient, not that the
-                # replica hung — same eligibility rule forward_predict
-                # applies (fleet.note_request_failure's contract)
-                eligible = hop_timeout >= min(fleet.generate_timeout,
-                                              fleet.probe_timeout)
+                hop_timeout, fwd_headers, eligible = \
+                    self._hop_budget(deadline)
                 try:
                     conn, resp = replica.client.open(
                         "POST", "/generate", self._body,
                         timeout=hop_timeout, headers=fwd_headers)
                 except replica_errs as e:
                     # failed before any byte reached the client: fail
-                    # FAST with a structured, retryable error (the
-                    # router never replays a generate itself)
+                    # FAST with a structured, retryable error
                     fleet.note_request_failure(replica, e,
                                                breaker_eligible=eligible)
-                    self._reply(502, {
-                        "error": "replica_failed",
-                        "replica": replica.id,
-                        "detail": f"{type(e).__name__}: {e}",
-                        "retryable": True})
+                    self._reply(502, replica_failed_body(
+                        replica.id, f"{type(e).__name__}: {e}"))
                     return
                 try:
                     if streaming and resp.status == 200:
@@ -350,11 +773,8 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                         # nothing yet, so the structured 502 still fits
                         fleet.note_request_failure(
                             replica, e, breaker_eligible=eligible)
-                        self._reply(502, {
-                            "error": "replica_failed",
-                            "replica": replica.id,
-                            "detail": f"{type(e).__name__}: {e}",
-                            "retryable": True})
+                        self._reply(502, replica_failed_body(
+                            replica.id, f"{type(e).__name__}: {e}"))
                         return
                     if resp.status < 500:
                         fleet.note_request_success(replica)
@@ -376,7 +796,6 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                     conn.close()
             finally:
                 fleet.release(replica)
-                fleet.observe("generate", time.perf_counter() - start)
 
         def _relay_stream(self, replica, resp,
                           breaker_eligible: bool = True) -> None:

@@ -15,9 +15,13 @@ thread, port-0 auto-assign, graceful close. Endpoints:
   independently on EOS or its own max_tokens ("n_tokens" is accepted as
   a legacy alias; the non-streaming response shape is unchanged).
   ``"stream": true`` switches the response to chunked transfer with one
-  NDJSON line per emitted token ({"row": r, "token": t}) and a final
+  NDJSON line per emitted token ({"row": r, "token": t,
+  "token_index": i} — `token_index` is the token's absolute per-row
+  position, the fleet router's failover dedupe key) and a final
   {"done": true, ...} summary line — clients see tokens as slots emit
-  them. Requires a transformer engine; 404 otherwise.
+  them. `max_tokens` and `token_index_base` accept a per-row list
+  (failover continuations). Requires a transformer engine; 404
+  otherwise.
 - ``POST /reload``   {"path": "<checkpoint dir or .ckpt>", "step": N?}
   — hot-swap every replica's weights from a checkpoint
   (docs/CHECKPOINTS.md) WITHOUT dropping in-flight requests: each
@@ -462,9 +466,19 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                 raise ValueError("prompt rows must be non-empty")
             # "max_tokens" is the contract; "n_tokens" stays as the
             # legacy alias so pre-continuous-batching clients keep
-            # working unchanged
-            max_tokens = int(data.get("max_tokens",
-                                      data.get("n_tokens", 16)))
+            # working unchanged. A list gives each row its OWN budget
+            # (failover continuations re-admit rows interrupted at
+            # different depths as one group — docs/FLEET.md)
+            max_tokens = data.get("max_tokens", data.get("n_tokens", 16))
+            max_tokens = ([int(m) for m in max_tokens]
+                          if isinstance(max_tokens, list)
+                          else int(max_tokens))
+            # absolute-index offset for streamed `token_index` chunks:
+            # a resumed request's replayed tokens ride in as prompt, so
+            # its first NEW token is not index 0 (scalar or per-row)
+            base = data.get("token_index_base", 0)
+            base = ([int(b) for b in base] if isinstance(base, list)
+                    else int(base))
             eos_id = data.get("eos_id")
             eos_id = None if eos_id is None else int(eos_id)
             streaming = bool(data.get("stream", False))
@@ -479,6 +493,10 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                     raise ValueError(
                         "eos_id/stream need the continuous-batching "
                         "decode loop (serve with slots >= 1)")
+                if isinstance(max_tokens, list):
+                    raise ValueError(
+                        "per-row max_tokens needs the continuous-"
+                        "batching decode loop (serve with slots >= 1)")
                 if deadline is not None:
                     deadline.check("generate")  # 504 before compute
                 out = generate_engine.generate(np.asarray(prompt),
@@ -492,7 +510,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
             # deadline 504s at submit, and again at slot admission
             streams = loop.submit_many(prompt, max_tokens, eos_id,
                                        deadline=deadline,
-                                       prefix_cache=use_prefix)
+                                       prefix_cache=use_prefix,
+                                       token_index_base=base)
             if streaming:
                 self._stream_tokens(streams, deadline)
                 return
@@ -611,9 +630,15 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                 return (_RESULT_TIMEOUT_S if deadline is None
                         else deadline.timeout(_RESULT_TIMEOUT_S))
 
+            # every token line carries its ABSOLUTE per-row index
+            # (token_index_base + emit ordinal): the fleet router's
+            # failover dedupe key — exactly-once across replica hops
+            # (clients that ignore it see the same stream as before)
             if len(streams) == 1:  # common case: emit inline
-                for tok in streams[0].tokens(timeout=wait_s()):
-                    chunk({"row": 0, "token": int(tok)})
+                for idx, tok in streams[0].indexed_tokens(
+                        timeout=wait_s()):
+                    chunk({"row": 0, "token": int(tok),
+                           "token_index": int(idx)})
             else:  # merge rows as they emit, one relay thread per slot
                 import queue as _queue
                 import threading as _threading
@@ -622,12 +647,13 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
 
                 def relay(r, s):
                     try:
-                        for tok in s.tokens(timeout=wait_s()):
-                            merged.put((r, int(tok)))
+                        for idx, tok in s.indexed_tokens(
+                                timeout=wait_s()):
+                            merged.put((r, int(idx), int(tok)))
                     except Exception:
                         pass  # surfaced via finish_reason below
                     finally:
-                        merged.put((r, None))
+                        merged.put((r, None, None))
 
                 workers = [_threading.Thread(target=relay, args=(r, s),
                                              daemon=True)
@@ -636,11 +662,12 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                     w.start()
                 live = len(streams)
                 while live:
-                    r, tok = merged.get()
+                    r, idx, tok = merged.get()
                     if tok is None:
                         live -= 1
                     else:
-                        chunk({"row": r, "token": tok})
+                        chunk({"row": r, "token": tok,
+                               "token_index": idx})
             chunk({"done": True,
                    "tokens": [s.prompt + s.result(wait_s())
                               if s.error is None else None

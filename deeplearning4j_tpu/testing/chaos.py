@@ -75,6 +75,14 @@ POINTS = {
                    "— drills prove mid-fork faults leave pool-page "
                    "accounting balanced",
     "router.forward": "fleet router, before forwarding to a replica",
+    "router.stream_resume": "fleet router, before each mid-stream "
+                            "/generate failover attempt (after a "
+                            "replica died/hung with the stream "
+                            "partially delivered, before the "
+                            "continuation is re-admitted on a "
+                            "survivor — error = a resume that "
+                            "fails, driving the bounded-attempts/"
+                            "in-band-error fallback)",
     "checkpoint.write": "before each checkpoint shard file write",
     "checkpoint.rename": "before each atomic rename publish "
                          "(manifest, COMMITTED marker)",

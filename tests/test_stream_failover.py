@@ -1,0 +1,629 @@
+"""Durable generation streams (ISSUE 15): mid-stream replica failover
+with exactly-once token delivery.
+
+The contracts under test (serving/router.py durable /generate engine,
+decode_loop.py `token_index_base`, server.py `token_index` chunks):
+
+1. **Continuation record**: the router tracks every token already
+   relayed per row; a replica dying / resetting mid-stream re-admits
+   `prompt + delivered` on a survivor and resumes from the first
+   undelivered token — the client sees a gapless, duplicate-free
+   stream that is BIT-IDENTICAL to an uninterrupted run (greedy argmax
+   decode is deterministic, so the survivor continues exactly where
+   the victim stopped).
+2. **Exactly-once**: dedupe is by absolute `token_index` (every
+   streamed chunk carries one); replayed indices are dropped and
+   counted, index gaps are treated as replica failure and replayed.
+3. **Bounded + budget-aware**: resume attempts cap at
+   `Fleet(stream_resume_attempts=)`; exhaustion falls back to the
+   legacy contract — 502 before the first byte, in-band
+   `{"error": "replica_failed", ..., "resume_attempts": N}` after it.
+4. **Non-streaming too**: the router drives the replica in streaming
+   mode even for non-streaming clients, so already-generated rows
+   survive a mid-batch replica death.
+5. **Prefix-cache opt-out honored across the hop**: a resumed
+   `"prefix_cache": false` request neither matches nor seeds the
+   survivor's cache.
+6. **Telemetry**: `dl4j_fleet_stream_{resumes,resume_failures,
+   tokens_replayed,tokens_deduped}` scraped off the live router
+   /metrics.
+
+Fast deterministic drills run in-process (tier-1); the SIGKILL and
+SIGSTOP drills on REAL replica processes (spawned via
+`cli serve --transformer`, so every process serves bit-identical
+weights) carry @slow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import (Fleet, InferenceEngine,
+                                        serve_fleet, serve_network)
+from deeplearning4j_tpu.serving.fleet import EVICTED
+from deeplearning4j_tpu.testing import chaos
+from deeplearning4j_tpu.testing.chaos import Rule
+from deeplearning4j_tpu.utils.httpd import start_http_server
+
+pytestmark = pytest.mark.chaos
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    chaos.deactivate()
+
+
+def _post(url, payload, timeout=120, headers=()):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(dict(headers))
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 headers=hdrs)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _stream(url, payload, timeout=120):
+    """POST a streaming /generate and return the NDJSON events."""
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 headers={"Content-Type":
+                                          "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        assert r.headers["Content-Type"].startswith(
+            "application/x-ndjson")
+        return [json.loads(ln) for ln in r if ln.strip()]
+
+
+def _net(n_in=4, n_out=3):
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(n_in).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=n_out)
+            .pretrain(False).build())
+    return MultiLayerNetwork(conf)
+
+
+@pytest.fixture(scope="module")
+def tf_setup():
+    import jax
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig, init_transformer_params)
+
+    cfg = TransformerConfig(vocab_size=17, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_len=64,
+                            interpret=True)
+    return init_transformer_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+class _Pair:
+    """N in-process replicas serving the SAME transformer weights
+    behind a router — the interchangeability the failover leans on."""
+
+    def __init__(self, tf_setup, n=2, prefix_cache=True, **fleet_kw):
+        params, cfg = tf_setup
+        self.handles = []
+        for _ in range(n):
+            gen = InferenceEngine.for_transformer(
+                params, cfg, prefix_cache=prefix_cache)
+            self.handles.append(serve_network(
+                _net(), n_replicas=1, max_delay_ms=1.0,
+                generate_engine=gen, slots=4, page_size=8,
+                prefix_cache=prefix_cache))
+        fleet_kw.setdefault("heartbeat_timeout", 5.0)
+        self.fleet = Fleet(start=False, **fleet_kw)
+        for h in self.handles:
+            self.fleet.attach(h.url)
+        for _ in range(200):
+            self.fleet.poll()
+            if self.fleet.ready_count() >= n:
+                break
+            time.sleep(0.02)
+        assert self.fleet.ready_count() >= n
+        self.router = serve_fleet(self.fleet)
+
+    @property
+    def url(self):
+        return self.router.url
+
+    def decode_stats(self):
+        return [_get(f"{h.url}/stats")["generate"]["decode"]
+                for h in self.handles]
+
+    def close(self):
+        self.router.close()
+        for h in self.handles:
+            h.close()
+
+
+def _token_events(events):
+    return [e for e in events if "token" in e]
+
+
+# =========================== in-process failover (tier-1 deterministic)
+class TestMidStreamFailover:
+    def test_reset_resumes_on_survivor_bit_identical(self, tf_setup):
+        """ISSUE flagship (in-process): a replica hard-resets its
+        socket mid-stream; the router resumes the generation on the
+        survivor and the client sees a gapless, duplicate-free stream
+        bit-identical to an uninterrupted reference — plus the
+        dl4j_fleet_stream_* series live on the router's /metrics."""
+        pair = _Pair(tf_setup)
+        body = {"prompt": [[1, 2, 3, 4]], "max_tokens": 8,
+                "stream": True}
+        try:
+            ref = _stream(f"{pair.url}/generate", body)
+            ref_toks = [e["token"] for e in _token_events(ref)]
+            assert len(ref_toks) == 8
+            # 3rd chunk write resets the connection: 2 tokens made it
+            # out, the rest must come from the survivor
+            chaos.configure([Rule("generate.midstream", "reset",
+                                  at=[3])])
+            out = _stream(f"{pair.url}/generate", body)
+            chaos.deactivate()
+            toks = _token_events(out)
+            assert [e["token"] for e in toks] == ref_toks
+            assert [e["token_index"] for e in toks] == list(range(8))
+            done = out[-1]
+            assert done["done"] and done["resumes"] == 1
+            assert done["tokens"] == ref[-1]["tokens"]
+            assert done["finish_reasons"] == ["max_tokens"]
+            snap = pair.fleet.snapshot()
+            assert snap["stream_resumes"] >= 1
+            assert snap["stream_resume_failures"] == 0
+            # replay prefill = prompt + the 2 delivered tokens
+            assert snap["stream_tokens_replayed"] >= 6
+            # the victim's reset cancelled its slots (pages freed); the
+            # survivor retired the resumed row; and resume was ordinary
+            # admission — never a new program
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                decs = pair.decode_stats()
+                if all(d["pages_in_use"] == 0 for d in decs):
+                    break
+                time.sleep(0.05)
+            assert all(d["pages_in_use"] == 0 for d in decs)
+            assert all(d["decode_step_programs"] == 1 for d in decs)
+            # satellite: the counters scrape END TO END off the live
+            # router /metrics (process-global registry — match THIS
+            # fleet's label, earlier tests leave their series behind)
+            with urllib.request.urlopen(f"{pair.url}/metrics",
+                                        timeout=30) as r:
+                text = r.read().decode()
+            label = f'fleet="{pair.fleet.label}"'
+            for series in ("dl4j_fleet_stream_resumes",
+                           "dl4j_fleet_stream_resume_failures",
+                           "dl4j_fleet_stream_tokens_replayed",
+                           "dl4j_fleet_stream_tokens_deduped"):
+                assert series in text
+            resumed = [ln for ln in text.splitlines()
+                       if ln.startswith(
+                           "dl4j_fleet_stream_resumes_total{")
+                       and label in ln]
+            assert resumed and float(resumed[0].split()[-1]) >= 1
+        finally:
+            pair.close()
+
+    def test_nonstream_multirow_rows_survive_replica_death(
+            self, tf_setup):
+        """ISSUE satellite: non-streaming /generate through the router
+        must not lose already-generated rows when the replica fails
+        mid-batch — the router buffers per-row progress, resumes the
+        unfinished rows, and the aggregated reply (rows AND
+        finish_reasons) matches an uninterrupted reference."""
+        pair = _Pair(tf_setup)
+        body = {"prompt": [[1, 2, 3], [4, 5, 6, 7]],
+                "max_tokens": 6}
+        try:
+            ref = _post(f"{pair.url}/generate", body)
+            assert ref["finish_reasons"] == ["max_tokens", "max_tokens"]
+            chaos.configure([Rule("generate.midstream", "reset",
+                                  at=[5])])
+            out = _post(f"{pair.url}/generate", body)
+            chaos.deactivate()
+            assert out["tokens"] == ref["tokens"]
+            assert out["finish_reasons"] == ref["finish_reasons"]
+            assert out["resumes"] >= 1
+        finally:
+            pair.close()
+
+    def test_resume_exhaustion_falls_back_inband_with_attempts(
+            self, tf_setup):
+        """No survivor to resume on: the stream ends with the legacy
+        in-band retryable error, now carrying `resume_attempts`."""
+        pair = _Pair(tf_setup, n=1)
+        try:
+            chaos.configure([Rule("generate.midstream", "reset",
+                                  at=[3])])
+            out = _stream(f"{pair.url}/generate",
+                          {"prompt": [[1, 2, 3, 4]], "max_tokens": 8,
+                           "stream": True})
+            chaos.deactivate()
+            toks = _token_events(out)
+            assert len(toks) == 3  # delivered before the reset (0-based
+            assert [e["token_index"] for e in toks] == [0, 1, 2]  # at=3)
+            err = out[-1]
+            assert err["error"] == "replica_failed"
+            assert err["retryable"] is True
+            assert err["resume_attempts"] == 1  # tried, no survivor
+            assert not any(e.get("done") for e in out)
+            assert pair.fleet.snapshot()["stream_resume_failures"] >= 1
+        finally:
+            pair.close()
+
+    def test_resume_exhaustion_before_first_byte_is_502(self, tf_setup):
+        """A non-streaming client never saw a byte, so exhaustion keeps
+        the clean status-code contract: 502 + the structured shape."""
+        pair = _Pair(tf_setup, n=1)
+        try:
+            chaos.configure([Rule("generate.midstream", "reset",
+                                  at=[2])])
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(f"{pair.url}/generate",
+                      {"prompt": [[1, 2, 3]], "max_tokens": 5})
+            chaos.deactivate()
+            assert e.value.code == 502
+            body = json.loads(e.value.read())
+            assert body["error"] == "replica_failed"
+            assert body["retryable"] is True
+            assert body["resume_attempts"] == 1
+        finally:
+            pair.close()
+
+    def test_stream_resume_chaos_point_blocks_every_resume(
+            self, tf_setup):
+        """The `router.stream_resume` chaos point sits exactly on the
+        re-admission path: an injected error there exhausts the
+        bounded attempts even though a healthy survivor exists."""
+        pair = _Pair(tf_setup)
+        try:
+            chaos.configure([Rule("generate.midstream", "reset",
+                                  at=[3]),
+                             Rule("router.stream_resume", "error",
+                                  message="resume forbidden")])
+            out = _stream(f"{pair.url}/generate",
+                          {"prompt": [[1, 2, 3, 4]], "max_tokens": 8,
+                           "stream": True})
+            chaos.deactivate()
+            err = out[-1]
+            assert err["error"] == "replica_failed"
+            assert err["resume_attempts"] == \
+                pair.fleet.stream_resume_attempts
+            assert "resume blocked" in err["detail"]
+        finally:
+            pair.close()
+
+    def test_prefix_cache_optout_not_seeded_on_replay(self, tf_setup):
+        """ISSUE satellite: a resumed `"prefix_cache": false` request
+        must neither match nor seed the cache on replay — and the
+        positive twin seeds the survivor exactly as a normal retire
+        would."""
+        # the replayed prompt (original 6 + 3 delivered = 9 tokens)
+        # spans a full 8-token page, so the survivor's retire WOULD
+        # seed it — unless the opt-out rides the hop
+        body = {"prompt": [[1, 2, 3, 4, 5, 6]], "max_tokens": 8,
+                "stream": True}
+        # opt-out: after a resumed completion, EVERY replica's cache
+        # is still empty
+        pair = _Pair(tf_setup)
+        try:
+            chaos.configure([Rule("generate.midstream", "reset",
+                                  at=[3])])
+            out = _stream(f"{pair.url}/generate",
+                          dict(body, prefix_cache=False))
+            chaos.deactivate()
+            assert out[-1]["done"] and out[-1]["resumes"] == 1
+            for dec in pair.decode_stats():
+                assert dec["prefix_cache"]["hits"] == 0
+                assert dec["prefix_cache"]["nodes"] == 0
+                assert dec["prefix_cache"]["pages_cached"] == 0
+        finally:
+            pair.close()
+        # default: the survivor's retire seeds the cache with the
+        # replayed-and-finished sequence
+        pair = _Pair(tf_setup)
+        try:
+            chaos.configure([Rule("generate.midstream", "reset",
+                                  at=[3])])
+            out = _stream(f"{pair.url}/generate", body)
+            chaos.deactivate()
+            assert out[-1]["done"] and out[-1]["resumes"] == 1
+            assert sum(d["prefix_cache"]["nodes"]
+                       for d in pair.decode_stats()) > 0
+        finally:
+            pair.close()
+
+
+# ============================ exactly-once dedupe against a noisy stub
+class TestExactlyOnceDedupe:
+    def test_duplicate_token_indices_relayed_once(self):
+        """A (stub) replica that replays already-delivered indices —
+        what a resumed stream with a conservative `token_index_base`
+        looks like — reaches the client exactly once, and the drops
+        are counted."""
+        lines = [{"row": 0, "token": 5, "token_index": 0},
+                 {"row": 0, "token": 5, "token_index": 0},   # dup
+                 {"row": 0, "token": 6, "token_index": 1},
+                 {"row": 0, "token": 6, "token_index": 1},   # dup
+                 {"row": 0, "token": 7, "token_index": 2},
+                 {"done": True, "tokens": [[9, 5, 6, 7]],
+                  "finish_reasons": ["max_tokens"]}]
+
+        class StubReplica(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = (b'{"ready": true}'
+                        if self.path.startswith("/readyz")
+                        else b'{"ok": true}')
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                for obj in lines:
+                    raw = (json.dumps(obj) + "\n").encode()
+                    self.wfile.write(f"{len(raw):x}\r\n".encode()
+                                     + raw + b"\r\n")
+                self.wfile.write(b"0\r\n\r\n")
+
+        srv = start_http_server(StubReplica)
+        fleet = Fleet(start=False, heartbeat_timeout=5.0)
+        try:
+            fleet.attach(srv.url)
+            for _ in range(100):
+                fleet.poll()
+                if fleet.ready_count():
+                    break
+                time.sleep(0.02)
+            deduped_before = fleet.snapshot()["stream_tokens_deduped"]
+            with serve_fleet(fleet) as router:
+                out = _stream(f"{router.url}/generate",
+                              {"prompt": [[9]], "max_tokens": 3,
+                               "stream": True})
+            toks = _token_events(out)
+            assert [e["token"] for e in toks] == [5, 6, 7]
+            assert [e["token_index"] for e in toks] == [0, 1, 2]
+            assert out[-1]["done"]
+            assert out[-1]["tokens"] == [[9, 5, 6, 7]]
+            assert (fleet.snapshot()["stream_tokens_deduped"]
+                    - deduped_before) == 2
+        finally:
+            fleet.close()
+            srv.close()
+
+
+# ===================== real processes: SIGKILL / SIGSTOP stream drills
+def _spawner(tmp_path, slow_ms=40):
+    """Replica processes serving /generate from `--transformer SPEC`:
+    deterministic init means every process carries bit-identical
+    weights. A chaos delay on each streamed chunk paces token emission
+    so the drill's signal lands MID-stream."""
+    from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
+    from deeplearning4j_tpu.serving.fleet import ReplicaSpawner
+
+    ckpt = str(tmp_path / "failover.ckpt")
+    DefaultModelSaver(ckpt, keep_old=False).save(_net())
+    spec = str(tmp_path / "tf.json")
+    with open(spec, "w") as f:
+        json.dump({"vocab_size": 17, "d_model": 32, "n_heads": 2,
+                   "n_layers": 2, "d_ff": 64, "max_len": 64,
+                   "interpret": True, "seed": 0}, f)
+    env = dict(os.environ,
+               PYTHONPATH=REPO_ROOT + os.pathsep
+               + os.environ.get("PYTHONPATH", ""),
+               JAX_PLATFORMS="cpu",
+               **chaos.env_spec([Rule("generate.midstream", "delay",
+                                      delay_s=slow_ms / 1000.0)]))
+    return ReplicaSpawner(ckpt,
+                          serve_args=["--max-delay-ms", "1",
+                                      "--transformer", spec,
+                                      "--slots", "4",
+                                      "--page-size", "8"],
+                          env=env)
+
+
+def _victim(fleet):
+    """The replica currently serving stream traffic."""
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        busy = [r for r in fleet._replicas.values() if r.outstanding]
+        if busy:
+            return busy[0]
+        time.sleep(0.02)
+    raise AssertionError("no replica ever went busy")
+
+
+@pytest.mark.slow
+class TestProcessDrills:
+    PROMPT = [1, 2, 3, 4]
+    N_TOKENS = 24
+
+    def _run_streams(self, router_url, n=3):
+        """n concurrent streaming clients, same prompt (deterministic
+        decode -> same expected tokens). Returns (results, failures)
+        after all threads join."""
+        results, failures = [None] * n, []
+
+        def worker(i):
+            try:
+                results[i] = _stream(
+                    f"{router_url}/generate",
+                    {"prompt": [self.PROMPT],
+                     "max_tokens": self.N_TOKENS, "stream": True},
+                    timeout=300)
+            except Exception as e:  # noqa: BLE001
+                failures.append(repr(e))
+
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    daemon=True) for i in range(n)]
+        for t in threads:
+            t.start()
+        return threads, results, failures
+
+    def _check_streams(self, results, ref_toks):
+        """Every stream: zero gaps, zero dups, bit-identical tokens."""
+        total_resumes = 0
+        for events in results:
+            toks = _token_events(events)
+            assert [e["token_index"] for e in toks] == \
+                list(range(self.N_TOKENS))
+            assert [e["token"] for e in toks] == ref_toks
+            done = events[-1]
+            assert done["done"]
+            assert done["tokens"] == [self.PROMPT + ref_toks]
+            total_resumes += done["resumes"]
+        return total_resumes
+
+    def test_sigkill_mid_stream_zero_client_failures(self, tmp_path):
+        """ISSUE acceptance drill: SIGKILL the serving replica while
+        concurrent streams are mid-flight — zero client-visible
+        failures, every stream gapless/duplicate-free and
+        bit-identical to the uninterrupted reference, resume counters
+        scraped off the live /metrics, and the survivor never compiled
+        a second decode program."""
+        fleet = Fleet(spawner=_spawner(tmp_path),
+                      heartbeat_interval=0.2, heartbeat_timeout=3.0,
+                      breaker_threshold=2, breaker_reset_s=0.4)
+        router = None
+        try:
+            fleet.spawn(2)
+            fleet.wait_ready(2, timeout=300)
+            router = serve_fleet(fleet)
+            # uninterrupted reference (also a warm pass: both the
+            # bucket programs and — on whichever replica served it —
+            # the prefix cache)
+            ref = _stream(f"{router.url}/generate",
+                          {"prompt": [self.PROMPT],
+                           "max_tokens": self.N_TOKENS,
+                           "stream": True}, timeout=300)
+            ref_toks = [e["token"] for e in _token_events(ref)]
+            assert len(ref_toks) == self.N_TOKENS
+
+            threads, results, failures = self._run_streams(router.url)
+            victim = _victim(fleet)
+            time.sleep(0.4)          # let a few tokens flow
+            chaos.sigkill(victim.proc)
+            for t in threads:
+                t.join(timeout=300)
+            assert failures == []    # ZERO client-visible failures
+            total_resumes = self._check_streams(results, ref_toks)
+            assert total_resumes >= 1
+
+            # live-scrape the resume counters off the router /metrics
+            with urllib.request.urlopen(f"{router.url}/metrics",
+                                        timeout=30) as r:
+                text = r.read().decode()
+            scraped = {ln.split("{")[0]: float(ln.split()[-1])
+                       for ln in text.splitlines()
+                       if ln.startswith("dl4j_fleet_stream_")
+                       and f'fleet="{fleet.label}"' in ln}
+            assert scraped["dl4j_fleet_stream_resumes_total"] >= 1
+            assert scraped["dl4j_fleet_stream_tokens_replayed_total"] \
+                >= len(self.PROMPT)
+
+            # the survivor: resume was ordinary admission (ONE decode
+            # program) and every page came back
+            survivor = next(r for r in fleet._replicas.values()
+                            if r.id != victim.id)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                dec = survivor.client.stats()["generate"]["decode"]
+                if dec["pages_in_use"] == 0:
+                    break
+                time.sleep(0.1)
+            assert dec["pages_in_use"] == 0
+            assert dec["decode_step_programs"] == 1
+        finally:
+            if router is not None:
+                router.close(stop_replicas=True)
+            else:
+                fleet.close(stop_replicas=True)
+
+    def test_sigstop_breaker_eviction_resumes_and_frees_pages(
+            self, tmp_path):
+        """Breaker-eviction flavor: the victim is SIGSTOPped
+        (hung-but-TCP-alive). The router's mid-stream read times out,
+        feeds the breaker (threshold 1 -> evicted), and the stream
+        resumes on the survivor. After SIGCONT the victim's abandoned
+        slots cancel (the router closed the connection) and its KV
+        pages come home."""
+        fleet = Fleet(spawner=_spawner(tmp_path),
+                      heartbeat_interval=0.2, heartbeat_timeout=60.0,
+                      generate_timeout=2.0,
+                      breaker_threshold=1, breaker_reset_s=30.0)
+        router = None
+        try:
+            fleet.spawn(2)
+            fleet.wait_ready(2, timeout=300)
+            router = serve_fleet(fleet)
+            ref = _stream(f"{router.url}/generate",
+                          {"prompt": [self.PROMPT],
+                           "max_tokens": self.N_TOKENS,
+                           "stream": True}, timeout=300)
+            ref_toks = [e["token"] for e in _token_events(ref)]
+
+            threads, results, failures = self._run_streams(router.url)
+            victim = _victim(fleet)
+            time.sleep(0.4)
+            chaos.sigstop(victim.proc)   # hung, NOT dead
+            for t in threads:
+                t.join(timeout=300)
+            assert failures == []
+            assert self._check_streams(results, ref_toks) >= 1
+            # the stalled stream read fed the breaker
+            deadline = time.monotonic() + 15.0
+            while victim.state != EVICTED:
+                assert time.monotonic() < deadline, \
+                    f"breaker never evicted: {fleet.snapshot()}"
+                time.sleep(0.05)
+            assert "circuit breaker" in victim.eviction_reason
+            chaos.sigcont(victim.proc)
+            # its orphaned slots cancel on the dead client connection
+            # and every origin-side KV page is freed
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                try:
+                    dec = victim.client.stats()["generate"]["decode"]
+                    if dec["pages_in_use"] == 0:
+                        break
+                except Exception:  # noqa: BLE001
+                    pass
+                time.sleep(0.2)
+            assert dec["pages_in_use"] == 0
+        finally:
+            if router is not None:
+                router.close(stop_replicas=True)
+            else:
+                fleet.close(stop_replicas=True)
