@@ -823,6 +823,244 @@ def bench_prefix_cache():
     }
 
 
+def bench_speculative():
+    """Speculative-decoding config (docs/SERVING.md "Speculative
+    decoding"): the chat-replay drill — templated prompts (shared
+    system head + short user tails) whose greedy continuations recur —
+    decoded plain vs draft-and-verify with BOTH drafter flavors at
+    BIT-IDENTICAL output. The gated metric is deterministic and
+    platform-independent: delivered tokens per TARGET-model dispatch
+    (the weight sweep speculation amortizes), which must be >= 2x the
+    plain lane's for both flavors. Wall tokens/sec is reported for
+    both lanes but only meaningful where the step is bandwidth/
+    dispatch-bound (the TPU lane); the CPU smoke is compute-bound, so
+    a widened verify costs ~W forwards and wall speedup < 1 there by
+    construction. The model flavor runs a draft DISTILLED on the
+    target's own greedy traffic (drafter-shaped right-aligned windows
+    — the positions the drafter actually sees), the pairing a real
+    deployment ships; acceptance rates for both flavors are also
+    scraped END TO END off a live /metrics."""
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig, init_transformer_params, transformer_logits)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
+    from deeplearning4j_tpu.serving.engine import InferenceEngine
+    from deeplearning4j_tpu.serving.kv_cache import generate_cached
+    from deeplearning4j_tpu.serving.server import serve_network
+
+    fast = _fast()
+    cfg = TransformerConfig(vocab_size=512, d_model=64 if fast else 256,
+                            n_heads=4, n_layers=2 if fast else 4,
+                            d_ff=128 if fast else 512,
+                            max_len=128 if fast else 512,
+                            interpret=fast)
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+    dcfg = TransformerConfig(vocab_size=512, d_model=32 if fast else 64,
+                             n_heads=2, n_layers=1,
+                             d_ff=64 if fast else 128,
+                             max_len=cfg.max_len, interpret=fast)
+    spec_k, draft_win = 4, 32
+    n_streams, cap = 8, 48
+    rng = np.random.RandomState(1)
+    system = rng.randint(0, cfg.vocab_size, (12,)).astype(np.int32)
+    prompts = [np.concatenate(
+        [system, rng.randint(0, cfg.vocab_size, (4,)).astype(np.int32)])
+        for _ in range(n_streams)]
+
+    # ---- distill the draft on the target's own greedy rollouts of
+    # this traffic, sample-shaped exactly like drafter inference:
+    # right-aligned zero-padded windows predicting the next token
+    seqs = np.asarray(generate_cached(
+        params, jnp.asarray(np.stack(prompts)), cfg, cap))
+    wins, labels = [], []
+    for s in seqs:
+        for cut in range(4, len(s)):
+            w = np.zeros((draft_win,), np.int32)
+            h = s[max(0, cut - draft_win):cut]
+            w[draft_win - len(h):] = h
+            wins.append(w)
+            labels.append(s[cut])
+    wins = np.stack(wins)
+    labels = np.asarray(labels, np.int32)
+
+    def distill_loss(p, w, y):
+        logits = transformer_logits(p, w, dcfg)[:, -1, :]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+
+    @jax.jit
+    def distill_step(p, m, v, i, w, y):
+        g = jax.grad(distill_loss)(p, w, y)
+        b1, b2, lr, eps = 0.9, 0.999, 3e-3, 1e-8
+        m = jax.tree_util.tree_map(lambda a, b: b1 * a + (1 - b1) * b,
+                                   m, g)
+        v = jax.tree_util.tree_map(
+            lambda a, b: b2 * a + (1 - b2) * b * b, v, g)
+
+        def upd(p_, m_, v_):
+            return p_ - lr * (m_ / (1 - b1 ** i)) / (
+                jnp.sqrt(v_ / (1 - b2 ** i)) + eps)
+
+        return jax.tree_util.tree_map(upd, p, m, v), m, v
+
+    dparams = init_transformer_params(jax.random.PRNGKey(7), dcfg)
+    m = jax.tree_util.tree_map(jnp.zeros_like, dparams)
+    v = jax.tree_util.tree_map(jnp.zeros_like, dparams)
+    t_distill = time.perf_counter()
+    wj, yj = jnp.asarray(wins), jnp.asarray(labels)
+    for i in range(1, 401):
+        idx = np.random.RandomState(i).randint(0, len(wins), (64,))
+        dparams, m, v = distill_step(dparams, m, v, jnp.float32(i),
+                                     wj[idx], yj[idx])
+    dparams = jax.tree_util.tree_map(np.asarray, dparams)
+    distill_s = time.perf_counter() - t_distill
+
+    # ---- the three lanes over the identical replayed workload
+    def run_lane(**kw):
+        loop = DecodeLoop(params, cfg, slots=n_streams, page_size=16,
+                          **kw)
+
+        def window():
+            streams = [loop.submit(list(p), cap) for p in prompts]
+            for s in streams:
+                s.result(240)
+            return [s.full_sequence(1) for s in streams]
+
+        outs = window()  # warmup: compiles + seeds the replay corpus
+        if kw.get("speculation"):
+            # the width-1 fallback chain is part of the speculative
+            # lane (rounds where nothing drafts run it) — warm it too
+            # so the recompile guard pins BOTH programs
+            loop.submit(list(prompts[0]), 2,
+                        speculation=False).result(240)
+        programs_warm = loop.decode_step_programs()
+        d0 = loop.snapshot()["dispatches"]
+        rate, win_s = _median_rate(window, n_streams * cap)
+        snap = loop.snapshot()
+        dispatches = (snap["dispatches"] - d0) / REPEATS
+        programs = loop.decode_step_programs()
+        spec = snap["speculation"]
+        loop.close()
+        return outs, {
+            "tokens_per_sec": round(rate, 2),
+            "tokens_per_dispatch":
+                round(n_streams * cap / dispatches, 2),
+            "dispatches_per_window": round(dispatches, 1),
+            "acceptance_rate": round(spec["acceptance_rate"], 4),
+            "proposed": spec["proposed"],
+            "accepted": spec["accepted"],
+            "decode_step_programs":
+                programs if programs >= 0 else None,
+            "recompiled_after_warmup":
+                (programs - programs_warm) if programs >= 0
+                and programs_warm >= 0 else None,
+            "window_s": round(win_s, 3),
+        }
+
+    ref, plain = run_lane()
+    out_ng, ngram = run_lane(speculation=spec_k, drafter="ngram")
+    out_md, model = run_lane(speculation=spec_k, drafter="model",
+                             draft_params=dparams, draft_cfg=dcfg,
+                             draft_window=draft_win)
+    identical = ref == out_ng == out_md
+    for lane, res in (("ngram", ngram), ("model", model)):
+        res["speedup_tokens_per_dispatch"] = round(
+            res["tokens_per_dispatch"] / plain["tokens_per_dispatch"],
+            2)
+        res["speedup_wall"] = round(
+            res["tokens_per_sec"] / plain["tokens_per_sec"], 2)
+    gate = bool(identical
+                and ngram["speedup_tokens_per_dispatch"] >= 2.0
+                and model["speedup_tokens_per_dispatch"] >= 2.0
+                and ngram["recompiled_after_warmup"] == 0
+                and model["recompiled_after_warmup"] == 0
+                and (ngram["decode_step_programs"] or 0) <= 2
+                and (model["decode_step_programs"] or 0) <= 2)
+
+    # ---- e2e: acceptance rate scraped off a LIVE /metrics
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(4).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=3)
+            .pretrain(False).build())
+
+    def post(url, payload):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    def series(text, name, agg):
+        # the registry is process-global: earlier lanes in THIS run
+        # left their (zeroed, closed-loop) series behind, so aggregate
+        # across labels instead of trusting line order
+        vals = [float(line.rsplit(" ", 1)[1])
+                for line in text.splitlines() if line.startswith(name)]
+        return agg(vals) if vals else -1.0
+
+    gen_engine = InferenceEngine.for_transformer(params, cfg)
+    with serve_network(MultiLayerNetwork(conf), n_replicas=1,
+                       max_delay_ms=1.0, generate_engine=gen_engine,
+                       slots=4, page_size=16, speculation=spec_k,
+                       drafter="model", draft_params=dparams,
+                       draft_cfg=dcfg,
+                       draft_window=draft_win) as handle:
+        first = post(f"{handle.url}/generate",
+                     {"prompt": [prompts[0].tolist()],
+                      "max_tokens": cap})
+        replay = post(f"{handle.url}/generate",
+                      {"prompt": [prompts[0].tolist()],
+                       "max_tokens": cap})
+        with urllib.request.urlopen(f"{handle.url}/metrics",
+                                    timeout=30) as r:
+            metrics_text = r.read().decode()
+        with urllib.request.urlopen(f"{handle.url}/stats",
+                                    timeout=30) as r:
+            spec_live = json.loads(r.read())[
+                "generate"]["decode"]["speculation"]
+    # dead bench-lane loops above still expose zeroed gauge lines;
+    # max picks the live serving loop's
+    rate_scraped = series(metrics_text, "dl4j_spec_acceptance_rate",
+                          max)
+    scrape_ok = (replay["tokens"] == first["tokens"]
+                 and "dl4j_spec_proposed" in metrics_text
+                 and "dl4j_spec_rounds" in metrics_text
+                 and spec_live["proposed"] >= 1
+                 and 0.0 < rate_scraped <= 1.0
+                 and abs(rate_scraped - spec_live["acceptance_rate"])
+                 < 1e-6)
+
+    return {
+        "value": ngram["speedup_tokens_per_dispatch"],
+        "unit": "x_tokens_per_target_dispatch",
+        "gate_2x": gate,
+        "outputs_identical": identical,
+        "spec_k": spec_k,
+        "workload": {"n_streams": n_streams, "max_tokens": cap,
+                     "system_head_tokens": int(system.size),
+                     "replayed_windows": REPEATS + 1},
+        "plain": plain,
+        "ngram": ngram,
+        "model": dict(model, distill_s=round(distill_s, 1),
+                      distill_pairs=len(wins)),
+        "metrics_scrape": {
+            "acceptance_rate": rate_scraped,
+            "proposed_total": spec_live["proposed"],
+            "replay_bit_identical": replay["tokens"] == first["tokens"],
+            "ok": scrape_ok},
+    }
+
+
 def bench_fleet():
     """Fleet config (docs/FLEET.md): (a) scaling curve — aggregate
     /predict rows/sec and client-side p99 through the router over 1 ->
@@ -2612,6 +2850,7 @@ CONFIGS = {
     "guardian": bench_guardian,
     "serve": bench_serve,
     "prefix_cache": bench_prefix_cache,
+    "speculative": bench_speculative,
     "fleet": bench_fleet,
     "chaos": bench_chaos,
     "stream_failover": bench_stream_failover,
@@ -2635,6 +2874,7 @@ METRIC_NAMES = {
     "guardian": "guardian_guarded_step_time_ms",
     "serve": "serving_decode_tokens_per_sec_cached",
     "prefix_cache": "serving_prefix_cache_prefill_token_reduction",
+    "speculative": "serving_speculative_tokens_per_dispatch_speedup",
     "fleet": "fleet_predict_rows_per_sec_4_replicas",
     "chaos": "chaos_sigstop_breaker_eviction_s",
     "stream_failover": "serving_stream_failover_p99_ttnt_ms",

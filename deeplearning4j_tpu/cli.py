@@ -73,19 +73,15 @@ def _load_model(path: str):
     return net
 
 
-def _transformer_engine(spec: str):
-    """Build a /generate engine from a `--transformer SPEC`: a JSON
-    object (inline or a file path) of TransformerConfig overrides plus
-    an optional "seed". Initialization is a pure function of
-    (seed, config), so every process launched with the same SPEC serves
-    bit-identical weights — the property the fleet's stream failover
-    leans on: a greedy decode resumed on a survivor continues exactly
-    where the dead replica stopped (docs/FLEET.md "Stream failover")."""
+def _transformer_from_spec(spec: str):
+    """(params, cfg) from a transformer SPEC: a JSON object (inline or
+    a file path) of TransformerConfig overrides plus an optional
+    "seed". Initialization is a pure function of (seed, config), so
+    every process given the same SPEC holds bit-identical weights."""
     import jax
 
     from deeplearning4j_tpu.models.transformer import (
         TransformerConfig, init_transformer_params)
-    from deeplearning4j_tpu.serving import InferenceEngine
 
     raw = spec
     if os.path.exists(spec):
@@ -93,10 +89,22 @@ def _transformer_engine(spec: str):
             raw = f.read()
     fields = json.loads(raw)
     if not isinstance(fields, dict):
-        raise ValueError("--transformer SPEC must be a JSON object")
+        raise ValueError("transformer SPEC must be a JSON object")
     seed = int(fields.pop("seed", 0))
     cfg = TransformerConfig(**fields)
     params = init_transformer_params(jax.random.PRNGKey(seed), cfg)
+    return params, cfg
+
+
+def _transformer_engine(spec: str):
+    """Build a /generate engine from a `--transformer SPEC`
+    (_transformer_from_spec). The same-SPEC determinism is the property
+    the fleet's stream failover leans on: a greedy decode resumed on a
+    survivor continues exactly where the dead replica stopped
+    (docs/FLEET.md "Stream failover")."""
+    from deeplearning4j_tpu.serving import InferenceEngine
+
+    params, cfg = _transformer_from_spec(spec)
     return InferenceEngine.for_transformer(params, cfg)
 
 
@@ -425,6 +433,10 @@ def cmd_serve(args) -> int:
             ck = {"path": os.path.abspath(args.model), "step": None}
         gen = (_transformer_engine(args.transformer)
                if args.transformer else None)
+        draft_params = draft_cfg = None
+        if getattr(args, "draft_model", None):
+            draft_params, draft_cfg = _transformer_from_spec(
+                args.draft_model)
         handle = serve_network(
             net, checkpoint=ck, generate_engine=gen,
             host=args.host, port=args.port, n_replicas=args.replicas,
@@ -432,13 +444,25 @@ def cmd_serve(args) -> int:
             max_delay_ms=args.max_delay_ms,
             max_queue=args.max_queue,
             slots=args.slots, page_size=args.page_size,
+            kv_pages=args.kv_pages,
             prefix_cache=args.prefix_cache,
             decode_kernel=args.decode_kernel,
+            horizon=args.horizon,
+            speculation=args.speculation,
+            drafter=args.drafter,
+            draft_params=draft_params, draft_cfg=draft_cfg,
+            draft_window=args.draft_window,
             warmup_shape=(n_in,) if (args.warmup and n_in) else None,
             warmup_async=args.warmup_async)
     except BaseException:
         tele.close()
         raise
+    # the announce line's "decode" object is the ONE self-describing
+    # record of the decode configuration this process actually runs —
+    # fleet spawner logs capture it, so a drill's replica config is
+    # auditable without re-deriving defaults (top-level slots/
+    # page_size/... stay for older log parsers)
+    loop = gen.decode_loop if gen is not None else None
     print(json.dumps({"serving": handle.url,
                       "replicas": len(handle.replicas.engines),
                       "max_batch_size": args.max_batch_size,
@@ -447,6 +471,32 @@ def cmd_serve(args) -> int:
                       "page_size": args.page_size,
                       "prefix_cache": args.prefix_cache,
                       "decode_kernel": args.decode_kernel,
+                      "decode": {
+                          "kernel": {
+                              "requested": args.decode_kernel,
+                              "selected": (loop.decode_kernel
+                                           if loop is not None else None),
+                          },
+                          "prefix_cache": args.prefix_cache,
+                          "slots": args.slots,
+                          "page_size": args.page_size,
+                          "kv_pages": (loop.n_pages
+                                       if loop is not None else None),
+                          "horizon": args.horizon,
+                          "speculation": {
+                              "enabled": bool(args.speculation),
+                              "k": args.speculation,
+                              "drafter": (
+                                  loop._drafter.kind
+                                  if loop is not None
+                                  and loop._drafter is not None
+                                  else None),
+                              "draft_window": (
+                                  args.draft_window
+                                  if args.drafter == "model"
+                                  and args.speculation else None),
+                          },
+                      },
                       "metrics": handle.url + "/metrics",
                       **tele.announce()}), flush=True)
     if args.smoke:  # start/stop sanity check (tests, deploy probes)
@@ -979,6 +1029,37 @@ def build_parser() -> argparse.ArgumentParser:
                               "SPEC serves bit-identical weights, which "
                               "is how fleet stream-failover drills get "
                               "interchangeable replicas (docs/FLEET.md)")
+    p_serve.add_argument("--kv-pages", type=int, default=None,
+                         help="size of the paged KV pool in pages "
+                              "(default: slots * ceil(max_len / "
+                              "page_size))")
+    p_serve.add_argument("--horizon", type=int, default=1,
+                         help="decode steps chained per dispatch "
+                              "(docs/SERVING.md; mutually exclusive "
+                              "with --speculation)")
+    p_serve.add_argument("--speculation", type=int, default=0,
+                         help="speculative decoding draft depth k "
+                              "(0 = off): a drafter proposes k tokens "
+                              "per slot and ONE widened verify step "
+                              "accepts the longest target-matching "
+                              "prefix — output stays bit-identical "
+                              "(docs/SERVING.md)")
+    p_serve.add_argument("--drafter", default="ngram",
+                         choices=("ngram", "model"),
+                         help="speculative drafter flavor: ngram = "
+                              "zero-weight prompt lookup fed by the "
+                              "prefix cache; model = a small draft "
+                              "transformer (--draft-model)")
+    p_serve.add_argument("--draft-model", default=None, metavar="SPEC",
+                         help="draft transformer for --drafter model: "
+                              "same JSON SPEC contract as "
+                              "--transformer (TransformerConfig fields "
+                              "+ \"seed\"); its vocab must match the "
+                              "serving model's")
+    p_serve.add_argument("--draft-window", type=int, default=32,
+                         help="token window the draft model conditions "
+                              "on (right-aligned slice of each slot's "
+                              "history)")
     p_serve.add_argument("--no-warmup", dest="warmup",
                          action="store_false",
                          help="skip precompiling the bucket programs")

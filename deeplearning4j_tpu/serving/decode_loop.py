@@ -85,6 +85,32 @@ program either way. Both figures are exported every dispatch as
 dl4j_decode_kv_read_bytes{path="kernel"|"gather"} so the traffic win
 is visible whichever lane runs.
 
+**Speculative decoding** (`speculation=k`, default off): each scheduler
+round, a drafter (serving/speculation.py — "ngram" prompt-lookup fed by
+the slot's own history and the prefix-cache trie, or "model" with a
+small draft transformer) proposes up to k continuation tokens per slot,
+and ONE widened verify dispatch (`paged_kv.paged_verify_step` — the
+horizon idea turned sideways: k+1 positions of one step instead of k+1
+chained steps) scores every position against the target model. The
+longest prefix where the draft matches the target's own argmax is
+accepted, plus the target's token at the first mismatch — so emitted
+output is BIT-IDENTICAL to non-speculative greedy decode by
+construction, and a wrong draft costs acceptance rate, never
+correctness. Accept/rollback is pure host bookkeeping: the per-slot
+length cursor advances by `accepted + 1`; rejected positions' K/V
+writes landed in pages the slot privately owns (the CoW guard forks the
+whole write range `[length, stop)` before dispatch, exactly as for
+horizon), are never readable (attention masks key positions past every
+query's cursor), and are overwritten before the cursor passes them.
+Opt-out per request with `submit*(..., speculation=False)` (HTTP
+`"speculation": false`) — that slot rides every verify at width 1,
+i.e. a plain decode step. Speculation and `horizon>1` are mutually
+exclusive: speculation is its own chunking. The compiled surface grows
+by exactly one program (decode + verify; `decode_step_programs()`
+counts both and tests/bench pin <= 2). Telemetry:
+dl4j_spec_{proposed,accepted,rounds} counters and an acceptance-rate
+gauge in snapshot()/stats (docs/SERVING.md "Speculative decoding").
+
 Telemetry: dl4j_kv_pages_total / dl4j_kv_pages_in_use /
 dl4j_kv_pages_shared / dl4j_kv_pages_cached /
 dl4j_decode_active_slots gauges, dl4j_decode_requests /
@@ -119,10 +145,12 @@ from deeplearning4j_tpu.serving.paged_kv import (copy_page,
                                                  paged_kv_bytes,
                                                  paged_prefill,
                                                  paged_prefill_ctx,
+                                                 paged_verify_step,
                                                  pages_for_tokens,
                                                  pages_per_slot,
                                                  prompt_buckets)
 from deeplearning4j_tpu.serving.prefix_cache import PrefixIndex
+from deeplearning4j_tpu.serving.speculation import build_drafter
 from deeplearning4j_tpu.testing import chaos
 from deeplearning4j_tpu.utils.jitcache import jit_cache_size
 
@@ -154,6 +182,12 @@ class GenerationStream:
         #: False = this request neither matches nor seeds the shared
         #: prefix cache (set by submit_many's per-request opt-out)
         self.prefix_cache = True
+        #: False = no speculative drafts for this request (its slot
+        #: rides every verify round at width 1 — a plain decode step).
+        #: Output is bit-identical either way; the opt-out exists for
+        #: latency A/Bs and for keeping draft-model compute off a
+        #: request entirely (set by submit_many)
+        self.speculation = True
         #: absolute index of the FIRST token this stream will emit —
         #: non-zero when the request is a failover continuation whose
         #: already-delivered tokens ride in as prompt context. The
@@ -277,6 +311,9 @@ class DecodeLoop:
                  page_size: int = 16, n_pages: Optional[int] = None,
                  horizon: int = 1, max_waiting: Optional[int] = None,
                  prefix_cache: bool = True, kernel: str = "auto",
+                 speculation: int = 0, drafter: str = "ngram",
+                 draft_params=None, draft_cfg=None,
+                 draft_window: int = 32, ngram: int = 3,
                  start: bool = True, name: Optional[str] = None):
         import jax
         import jax.numpy as jnp
@@ -285,6 +322,14 @@ class DecodeLoop:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if horizon < 1:
             raise ValueError(f"horizon must be >= 1, got {horizon}")
+        if speculation < 0:
+            raise ValueError(
+                f"speculation must be >= 0, got {speculation}")
+        if speculation and horizon > 1:
+            raise ValueError(
+                "speculation and horizon>1 are mutually exclusive: "
+                "speculation replaces the horizon chain with "
+                "draft-and-verify chunking (pick one)")
         if max_waiting is not None and max_waiting < 0:
             raise ValueError(
                 f"max_waiting must be >= 0, got {max_waiting}")
@@ -293,6 +338,8 @@ class DecodeLoop:
         self.slots = int(slots)
         self.page_size = int(page_size)
         self.horizon = int(horizon)
+        #: drafts per verify round (0 = speculation off)
+        self.spec_k = int(speculation)
         # resolve "auto" ONCE, before jitting: the lane is a
         # compile-time constant of the single step program
         self.kernel_requested = kernel
@@ -340,6 +387,18 @@ class DecodeLoop:
         self._ref = np.zeros((self.n_pages,), np.int32)
         self._prefill_token_count = 0  # real tokens through prefill
 
+        # speculative decoding ----------------------------------------
+        # the drafter proposes; the verify program below is the only
+        # authority on emitted tokens (serving/speculation.py)
+        self._drafter = None
+        if self.spec_k:
+            corpus = ((lambda: self._prefix.iter_sequences())
+                      if self._prefix is not None else None)
+            self._drafter = build_drafter(
+                drafter, k=self.spec_k, cfg=cfg,
+                draft_params=draft_params, draft_cfg=draft_cfg,
+                draft_window=draft_window, ngram=ngram, corpus=corpus)
+
         # compiled programs -------------------------------------------
         # donation lets XLA update the pool in place on accelerators;
         # CPU ignores donation with a warning, so gate it off there
@@ -379,8 +438,19 @@ class DecodeLoop:
                 ctx_len, cfg)
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), pool
 
+        def verify_fn(params, tokens, pool, table, lengths, widths):
+            """ONE widened step over (S, W) tokens: every real column
+            writes K/V at `lengths + j` and the returned argmax row is
+            the target model's own next-token choice after each draft
+            prefix — the exact-accept rule's ground truth."""
+            logits, pool = paged_verify_step(
+                params, tokens, pool, table, lengths, widths, cfg,
+                kernel=self.decode_kernel)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), pool
+
         donate_copy = () if jax.default_backend() == "cpu" else (0,)
         self._step = jax.jit(step_fn, donate_argnums=donate_step)
+        self._verify = jax.jit(verify_fn, donate_argnums=donate_step)
         self._prefill = jax.jit(prefill_fn, donate_argnums=donate_pre)
         self._prefill_ctx = jax.jit(prefill_ctx_fn,
                                     donate_argnums=donate_pre)
@@ -445,6 +515,19 @@ class DecodeLoop:
             "dl4j_kv_prefix_evictions",
             "unreferenced cached prefix pages evicted (LRU) to satisfy "
             "an allocation under page pressure").labels(**lab)
+        self._m_spec_proposed = reg.counter(
+            "dl4j_spec_proposed",
+            "draft tokens proposed to speculative verify rounds"
+        ).labels(**lab)
+        self._m_spec_accepted = reg.counter(
+            "dl4j_spec_accepted",
+            "draft tokens the target model's verify accepted (each one "
+            "a decode dispatch saved)").labels(**lab)
+        self._m_spec_rounds = reg.counter(
+            "dl4j_spec_rounds",
+            "widened verify dispatches run (speculative rounds; plain "
+            "fallback rounds when no slot had a draft are not counted "
+            "here)").labels(**lab)
         _kv_read = reg.counter(
             "dl4j_decode_kv_read_bytes",
             "KV bytes the decode attention read must touch, summed "
@@ -488,6 +571,13 @@ class DecodeLoop:
             "slots holding an in-flight request").labels(
                 **lab).set_function(
             lambda: (lambda o: o.occupied_slots if o else 0)(ref()))
+        reg.gauge(
+            "dl4j_spec_acceptance_rate",
+            "accepted / proposed draft tokens over the loop's lifetime "
+            "(0.0 while speculation is off or nothing was proposed)"
+        ).labels(**lab).set_function(
+            lambda: (lambda o: o.spec_acceptance_rate if o else 0.0)(
+                ref()))
 
         if start:
             self._thread = threading.Thread(target=self._run, daemon=True,
@@ -532,22 +622,27 @@ class DecodeLoop:
     def submit(self, prompt, max_tokens: int,
                eos_id: Optional[int] = None,
                deadline: Optional[Deadline] = None,
-               prefix_cache: bool = True) -> GenerationStream:
+               prefix_cache: bool = True,
+               speculation: bool = True) -> GenerationStream:
         """Queue one prompt (1-D int sequence). The stream's first token
         arrives after admission + prefill; termination on EOS (when
         given), `max_tokens`, or the model window. `prefix_cache=False`
         opts this request out of the shared prefix cache — it neither
         reuses cached pages nor seeds new ones (benchmark cold runs;
-        secret-bearing prompts)."""
+        secret-bearing prompts). `speculation=False` opts it out of
+        speculative drafting (plain one-token rounds; output is
+        bit-identical either way)."""
         return self.submit_many([prompt], max_tokens, eos_id,
                                 deadline=deadline,
-                                prefix_cache=prefix_cache)[0]
+                                prefix_cache=prefix_cache,
+                                speculation=speculation)[0]
 
     def submit_many(self, prompts, max_tokens,
                     eos_id: Optional[int] = None,
                     deadline: Optional[Deadline] = None,
                     prefix_cache: bool = True,
-                    token_index_base=0
+                    token_index_base=0,
+                    speculation: bool = True
                     ) -> List[GenerationStream]:
         """Admit several rows as ONE unit: all rows enqueue or none do.
         A shed that fired between a multi-row request's submits would
@@ -561,7 +656,10 @@ class DecodeLoop:
         for every row or a per-row sequence (length == len(prompts)).
         Per-row budgets are what a failover continuation needs: rows
         interrupted at different depths re-admit as one group, each
-        with its own remaining budget and absolute-index offset."""
+        with its own remaining budget and absolute-index offset. Both
+        per-row lists are length- and value-checked UP FRONT with a
+        named error — a short or negative list must fail before any
+        row-mate is enqueued, not deep in slot admission."""
         if deadline is not None and deadline.expired:
             self._m_deadline.inc()
             deadline.check("decode admission")  # raises
@@ -569,6 +667,10 @@ class DecodeLoop:
                                     "max_tokens")
         per_row_base = self._per_row(token_index_base, len(prompts),
                                      "token_index_base")
+        for base in per_row_base:
+            if base < 0:
+                raise ValueError(
+                    f"per-row token_index_base must be >= 0, got {base}")
         prompts = [self.validate(p, mt)
                    for p, mt in zip(prompts, per_row_max)]
         streams = [GenerationStream(p, mt, eos_id, deadline=deadline)
@@ -577,9 +679,7 @@ class DecodeLoop:
         for stream, base in zip(streams, per_row_base):
             stream._loop_ref = loop_ref
             stream.prefix_cache = bool(prefix_cache)
-            if base < 0:
-                raise ValueError(
-                    f"token_index_base must be >= 0, got {base}")
+            stream.speculation = bool(speculation)
             stream.token_index_base = base
         with self._cond:
             if self._closed:
@@ -701,15 +801,34 @@ class DecodeLoop:
         return (self._thread is not None and self._thread.is_alive()
                 and not self._closed)
 
+    @property
+    def spec_acceptance_rate(self) -> float:
+        """Accepted / proposed draft tokens over the loop's lifetime
+        (0.0 while speculation is off or nothing was proposed yet)."""
+        proposed = int(self._m_spec_proposed.value)
+        if proposed <= 0:
+            return 0.0
+        return int(self._m_spec_accepted.value) / proposed
+
     def kv_pool_bytes(self) -> int:
         return paged_kv_bytes(self.cfg, self.n_pages, self.page_size)
 
     def decode_step_programs(self) -> int:
-        """Compiled-program count for the shared decode step — the
-        continuous-batching recompile guard: exactly 1 after warmup, no
-        matter how requests join/leave. -1 when the private jax counter
-        API drifted."""
-        return jit_cache_size(self._step)
+        """Compiled-program count for the decode lane — the
+        continuous-batching recompile guard. Plain mode: exactly 1
+        after warmup, no matter how requests join/leave. Speculative
+        mode: decode + widened verify, pinned <= 2 (both fixed-shape;
+        membership is traced). -1 when the private jax counter API
+        drifted."""
+        n = jit_cache_size(self._step)
+        if n < 0:
+            return n
+        if self.spec_k:
+            nv = jit_cache_size(self._verify)
+            if nv < 0:
+                return -1
+            n += nv
+        return n
 
     def prefill_programs(self) -> int:
         """Compiled prefill programs — bounded by the prompt bucket
@@ -759,6 +878,21 @@ class DecodeLoop:
                     "cached_unreferenced": self._cached_unref(),
                     "nodes": (0 if self._prefix is None
                               else len(self._prefix)),
+                },
+                "speculation": {
+                    "enabled": bool(self.spec_k),
+                    "k": self.spec_k,
+                    "drafter": (None if self._drafter is None
+                                else self._drafter.kind),
+                    "proposed": int(self._m_spec_proposed.value),
+                    "accepted": int(self._m_spec_accepted.value),
+                    "rounds": int(self._m_spec_rounds.value),
+                    "acceptance_rate": self.spec_acceptance_rate,
+                    "draft_programs": (
+                        self._drafter.draft_programs()
+                        if self._drafter is not None
+                        and hasattr(self._drafter, "draft_programs")
+                        else 0),
                 },
             }
 
@@ -1055,15 +1189,21 @@ class DecodeLoop:
     # ---- page granting
     def _grant_pages(self) -> None:
         """Before a dispatch: give every occupied slot pages covering
-        its next `horizon` positions (capped at its token budget) and
-        set its device `stop` bound to the granted frontier — a slot
-        the pool cannot extend simply stops advancing there."""
+        its next advance-window positions (`horizon` plain steps, or
+        the `spec_k`-draft + 1 verify width in speculative mode, capped
+        at its token budget) and set its device `stop` bound to the
+        granted frontier — a slot the pool cannot extend simply stops
+        advancing there. Because the CoW guard fences the WHOLE
+        [length, stop) window, every position a speculative verify may
+        write — including draft tokens that get rejected — lands in
+        private pages: rollback is just the host cursor not moving."""
+        adv = (self.spec_k + 1) if self.spec_k else self.horizon
         with self._cond:
             for i, slot in enumerate(self._slot_state):
                 if slot is None:
                     continue
                 length = int(self._lengths[i])
-                target = min(length + self.horizon, slot.stop_len)
+                target = min(length + adv, slot.stop_len)
                 want = pages_for_tokens(target, self.page_size)
                 granted = False
                 while len(slot.pages) < want:
@@ -1129,8 +1269,16 @@ class DecodeLoop:
             self._dirty = True
         return stop
 
-    # ---- one compiled dispatch (horizon token steps)
+    # ---- one compiled dispatch
     def _dispatch(self) -> bool:
+        """Route one dispatch round: draft-and-verify when speculation
+        is on, the horizon chain otherwise."""
+        if self.spec_k:
+            return self._dispatch_spec()
+        return self._dispatch_plain()
+
+    # ---- plain dispatch (horizon token steps)
+    def _dispatch_plain(self) -> bool:
         import jax.numpy as jnp
 
         self._grant_pages()
@@ -1192,6 +1340,129 @@ class DecodeLoop:
                 self._emit_and_maybe_finish(i, slot, tok)
                 if self._slot_state[i] is None:
                     break  # retired: discard speculative overshoot
+        return True
+
+    # ---- speculative dispatch (draft k on the host, verify k+1 wide)
+    def _dispatch_spec(self) -> bool:
+        """One draft-and-verify round. Per runnable slot the drafter
+        proposes up to k continuation tokens; ONE widened verify step
+        feeds `[pending, d_1..d_k]` at cursors `length..length+k` and
+        returns the target model's argmax after every prefix. The
+        accepted run is the longest m with `d_j == argmax_{j-1}`, and
+        the emitted tokens are `argmax_0..argmax_m` — the first
+        disagreement (or the tail when all agree) is the verify step's
+        OWN next token, so each round delivers m+1 tokens and the
+        stream is bit-identical to plain decode by induction. Rollback
+        of rejected positions is pure host bookkeeping: the cursor just
+        doesn't advance past m, and the garbage K/V beyond it sits in
+        CoW-private pages (see `_grant_pages`), masked by `key_pos <=
+        query_pos`, and overwritten by the next round before any query
+        can see it."""
+        import jax.numpy as jnp
+
+        # drafting extends each slot's last token on the HOST, so any
+        # deferred prefill firsts flush (one D2H per group) and emit
+        # now — same firsts-before-chunk order as the plain lane
+        if self._deferred:
+            self._flush_first_tokens()
+            self._dirty = True  # firsts never reached the device carry
+        self._grant_pages()
+        with self._cond:
+            runnable = [i for i, s in enumerate(self._slot_state)
+                        if s is not None
+                        and self._stop[i] > self._lengths[i]]
+            if not runnable:
+                return False
+            before = self._lengths.copy()
+        W = self.spec_k + 1
+        tokens = np.zeros((self.slots, W), np.int32)
+        widths = np.zeros((self.slots,), np.int32)
+        proposals = {}
+        model_rows = []
+        for i in runnable:
+            slot = self._slot_state[i]
+            tokens[i, 0] = self._pending[i]
+            widths[i] = 1
+            # room for length-advance this round; >= 2 means at least
+            # one draft position fits under the granted/budget frontier
+            room = int(self._stop[i] - before[i])
+            if room < 2 or not slot.stream.speculation:
+                continue
+            if self._drafter.kind == "model":
+                model_rows.append(i)
+            else:
+                history = slot.stream.prompt + slot.stream._generated
+                prop = self._drafter.propose(
+                    history, min(self.spec_k, room - 1))
+                if prop:
+                    proposals[i] = [int(t) for t in prop]
+        if model_rows:
+            # one fixed-shape (S, window) batch through the draft
+            # program — idle rows ride along and are ignored
+            win = self._drafter.window
+            windows = np.zeros((self.slots, win), np.int32)
+            for i in model_rows:
+                slot = self._slot_state[i]
+                hist = (slot.stream.prompt
+                        + slot.stream._generated)[-win:]
+                windows[i, win - len(hist):] = hist
+            drafted = self._drafter.propose_all(windows, self.spec_k)
+            for i in model_rows:
+                room = int(self._stop[i] - before[i])
+                prop = [int(t) for t in
+                        drafted[i, :min(self.spec_k, room - 1)]]
+                if prop:
+                    proposals[i] = prop
+        if not proposals:
+            # nothing drafted — run the plain width-1 chain instead so
+            # an idle/unluckly round costs exactly what it always did
+            # and the plain program stays warm
+            return self._dispatch_plain()
+        for i, prop in proposals.items():
+            n = len(prop)
+            tokens[i, 1:1 + n] = prop
+            widths[i] = 1 + n
+            self._m_spec_proposed.inc(n)
+        t0 = time.perf_counter()
+        out, self._pool = self._verify(
+            self.params, jnp.asarray(tokens), self._pool,
+            jnp.asarray(self._table), jnp.asarray(before),
+            jnp.asarray(widths))
+        self._m_steps.inc()
+        self._m_spec_rounds.inc()
+        out = np.asarray(out)  # (S, W) argmax — the sync streams need
+        self._m_step_s.observe(time.perf_counter() - t0)
+        # KV read accounting mirrors the widened step: column j of slot
+        # i attends at cursor before+j (clamped to its real width)
+        for j in range(int(widths.max())):
+            cur = before + np.minimum(j, np.maximum(widths - 1, 0))
+            self._m_kv_read["kernel"].inc(
+                decode_read_bytes(self._pool, cur, self._pps))
+            self._m_kv_read["gather"].inc(
+                decode_read_bytes(self._pool, cur, self._pps,
+                                  dense=True))
+        for i in runnable:
+            slot = self._slot_state[i]
+            if slot is None:
+                continue
+            prop = proposals.get(i, [])
+            m = 0
+            while m < len(prop) and prop[m] == int(out[i, m]):
+                m += 1
+            with self._cond:
+                self._lengths[i] = before[i] + m + 1
+            if prop:
+                self._m_spec_accepted.inc(m)
+            for j in range(m + 1):
+                tok = int(out[i, j])
+                self._pending[i] = tok
+                slot.emitted += 1
+                self._emit_and_maybe_finish(i, slot, tok)
+                if self._slot_state[i] is None:
+                    break  # retired (eos/budget): overshoot discarded
+        # host cursors moved without touching the plain device carry —
+        # any later plain-lane dispatch must re-upload
+        self._dirty = True
         return True
 
     def _flush_first_tokens(self) -> None:

@@ -179,6 +179,9 @@ class InferenceEngine:
         #: surfaced through /readyz and /stats so the deployment
         #: controller can verify a promotion landed (docs/PIPELINE.md)
         self.checkpoint: Optional[dict] = None
+        #: identity of the speculative draft model's checkpoint, when
+        #: one was hot-loaded via load_draft_params (None otherwise)
+        self.draft_checkpoint: Optional[dict] = None
         self.stats = EngineStats()
         from deeplearning4j_tpu.telemetry import device as _tdev
         _tdev.watch_jit_cache("serving_engine", self.program_cache_size)
@@ -198,6 +201,11 @@ class InferenceEngine:
                         max_waiting: Optional[int] = None,
                         prefix_cache: bool = True,
                         decode_kernel: str = "auto",
+                        horizon: int = 1,
+                        speculation: int = 0,
+                        drafter: str = "ngram",
+                        draft_params=None, draft_cfg=None,
+                        draft_window: int = 32,
                         **kw) -> "InferenceEngine":
         """Wrap a transformer LM: apply = full logits (B, T, vocab);
         `generate()` runs the per-request KV-cached compiled scan.
@@ -207,7 +215,12 @@ class InferenceEngine:
         bound its admission queue, `prefix_cache=False` to disable
         cross-request KV prefix sharing, and `decode_kernel` to pick
         the decode attention lane ("auto" = the Pallas paged kernel on
-        TPU, dense gather elsewhere — docs/SERVING.md)."""
+        TPU, dense gather elsewhere — docs/SERVING.md). `horizon > 1`
+        chains K decode steps per dispatch; `speculation = k > 0`
+        instead turns on draft-and-verify speculative decoding with
+        the chosen `drafter` flavor ("ngram", or "model" with
+        `draft_params`/`draft_cfg` — docs/SERVING.md "Speculative
+        decoding")."""
         from deeplearning4j_tpu.models.transformer import transformer_logits
         from deeplearning4j_tpu.serving.kv_cache import generate_cached
 
@@ -221,7 +234,13 @@ class InferenceEngine:
                                   n_pages=kv_pages,
                                   max_waiting=max_waiting,
                                   prefix_cache=prefix_cache,
-                                  kernel=decode_kernel)
+                                  kernel=decode_kernel,
+                                  horizon=horizon,
+                                  speculation=speculation,
+                                  drafter=drafter,
+                                  draft_params=draft_params,
+                                  draft_cfg=draft_cfg,
+                                  draft_window=draft_window)
         return eng
 
     @classmethod
@@ -296,14 +315,20 @@ class InferenceEngine:
                           horizon: int = 1,
                           max_waiting: Optional[int] = None,
                           prefix_cache: bool = True,
-                          kernel: str = "auto"):
+                          kernel: str = "auto",
+                          speculation: int = 0,
+                          drafter: str = "ngram",
+                          draft_params=None, draft_cfg=None,
+                          draft_window: int = 32):
         """Start the continuous-batching slot scheduler
         (serving/decode_loop.py) for this transformer engine: S slots
         over a paged KV pool riding ONE compiled decode step. `/generate`
         traffic routes here instead of the per-request compiled-scan
         path — requests join/leave at token boundaries and KV memory
         scales with written tokens. `kernel` picks the decode attention
-        lane ("auto"|"pallas"|"gather", docs/SERVING.md)."""
+        lane ("auto"|"pallas"|"gather", docs/SERVING.md);
+        `speculation = k` turns on draft-and-verify with the chosen
+        `drafter` ("ngram"|"model")."""
         from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
 
         if self._tf_cfg is None:
@@ -317,20 +342,29 @@ class InferenceEngine:
                                       n_pages=n_pages, horizon=horizon,
                                       max_waiting=max_waiting,
                                       prefix_cache=prefix_cache,
-                                      kernel=kernel)
+                                      kernel=kernel,
+                                      speculation=speculation,
+                                      drafter=drafter,
+                                      draft_params=draft_params,
+                                      draft_cfg=draft_cfg,
+                                      draft_window=draft_window)
         return self.decode_loop
 
     def generate_stream(self, prompt, max_tokens: int,
-                        eos_id: Optional[int] = None):
+                        eos_id: Optional[int] = None,
+                        speculation: bool = True):
         """Submit one prompt (1-D token sequence) to the slot scheduler;
         returns a `GenerationStream` emitting tokens as they come off
         the chip, terminated by EOS or `max_tokens`. Requires
-        `start_decode_loop` (or `decode_slots=` at construction)."""
+        `start_decode_loop` (or `decode_slots=` at construction).
+        `speculation=False` opts this request out of speculative
+        drafting (output is bit-identical either way)."""
         if self.decode_loop is None:
             raise ValueError(
                 "this engine has no decode loop (pass decode_slots= to "
                 "for_transformer or call start_decode_loop)")
-        return self.decode_loop.submit(prompt, max_tokens, eos_id)
+        return self.decode_loop.submit(prompt, max_tokens, eos_id,
+                                       speculation=speculation)
 
     def close(self) -> None:
         """Drain and stop the decode loop (no-op without one)."""
@@ -372,6 +406,28 @@ class InferenceEngine:
             self.decode_loop.params = params
         self.checkpoint = dict(checkpoint) if checkpoint else None
 
+    def load_draft_params(self, params, *,
+                          checkpoint: Optional[dict] = None) -> None:
+        """Swap the speculative DRAFT model's weights in place — the
+        `/reload {"target": "draft"}` path the deployment pipeline uses
+        to canary a new draft model without touching serving weights.
+        Requires a decode loop running a model drafter. Same contract
+        as `load_params`: leaf-for-leaf validation against the current
+        draft tree, then one reference assignment. A bad draft model
+        can only cost acceptance rate, never correctness — the target
+        verify step still decides every emitted token."""
+        from deeplearning4j_tpu.checkpoint.restore import validate_like
+
+        drafter = (None if self.decode_loop is None
+                   else self.decode_loop._drafter)
+        if drafter is None or drafter.kind != "model":
+            raise ValueError(
+                "no draft model to reload: the decode loop must be "
+                "running with speculation > 0 and drafter='model'")
+        validate_like(params, drafter.params, context="draft reload")
+        drafter.load_params(params)
+        self.draft_checkpoint = dict(checkpoint) if checkpoint else None
+
     # ---------------------------------------------------- observability
     def warmup(self, feature_shape: Sequence[int],
                dtype=np.float32) -> None:
@@ -400,6 +456,8 @@ class InferenceEngine:
         snap["buckets"] = list(self.buckets)
         snap["compiled_programs"] = self.program_cache_size()
         snap["checkpoint"] = self.checkpoint
+        if self.draft_checkpoint is not None:
+            snap["draft_checkpoint"] = self.draft_checkpoint
         if self.device is not None:
             snap["device"] = str(self.device)
         if self.decode_loop is not None:

@@ -53,7 +53,7 @@ from deeplearning4j_tpu.serving.kv_cache import _ffn, _heads
 __all__ = ["PagedKVPool", "init_paged_pool", "paged_kv_bytes",
            "pages_per_slot", "pages_for_tokens", "prompt_buckets",
            "paged_prefill", "paged_prefill_ctx", "paged_decode_step",
-           "copy_page", "decode_read_bytes"]
+           "paged_verify_step", "copy_page", "decode_read_bytes"]
 
 
 class PagedKVPool(NamedTuple):
@@ -303,6 +303,109 @@ def decode_read_bytes(pool: PagedKVPool, lengths, table_width: int, *,
         pages = sum(min(int(pos) // ps + 1, int(table_width))
                     for pos in lengths)
     return 2 * len(pool.layers) * page_bytes * int(pages)
+
+
+def paged_verify_step(params, tokens, pool: PagedKVPool, page_table,
+                      lengths, widths, cfg: TransformerConfig,
+                      kernel: str = "gather"):
+    """The WIDENED decode step speculative verify rides: `tokens` is
+    (S, W) — row s's column j is the token whose K/V belongs at cursor
+    `lengths[s] + j` (column 0 is the slot's ordinary pending token,
+    columns 1..W-1 the drafter's proposals). `widths` (S,) int32 is how
+    many columns of each row are real (0 = idle slot; 1 = plain
+    non-speculative step riding along). Returns
+    (logits (S, W, vocab), updated pool).
+
+    All real positions write K/V through the page table in one
+    dispatch (columns past a row's width write to the trash page, same
+    contract as `paged_decode_step`'s inactive slots) and every query
+    attends causally — column j sees positions <= lengths[s] + j, so
+    draft K/V written "in the future" of a query is masked exactly like
+    unwritten page-tail garbage. logits[s, j] is therefore the target
+    model's next-token distribution after the prefix extended by
+    proposals 1..j — the verify/accept rule's ground truth. Rejected
+    columns leave garbage at positions past the rolled-back cursor:
+    always masked (key position > every later query's cursor is
+    impossible — the cursor only moves forward over freshly-written
+    positions), then overwritten before ever becoming visible.
+
+    `kernel` mirrors `paged_decode_step`: "gather" runs one widened
+    masked-softmax over the dense window; "pallas" reuses the
+    single-query streamed kernel once per column (KV reads are
+    inherently O(W x written pages) either way — speculation's win is
+    amortizing the weight sweep and dispatch, not the KV reads)."""
+    if kernel not in ("gather", "pallas"):
+        raise ValueError(
+            f"kernel must be 'gather' or 'pallas' here (resolve 'auto' "
+            f"via attention.paged_pallas.resolve_decode_kernel), "
+            f"got {kernel!r}")
+    s, w = tokens.shape
+    d = cfg.d_model
+    hd = d // cfg.n_heads
+    ps = pool.page_size
+    trash = pool.trash_page
+    n_p = page_table.shape[1]
+    window = n_p * ps
+    pos = lengths[:, None] + jnp.arange(w)[None, :]        # (S, W)
+    valid = jnp.arange(w)[None, :] < widths[:, None]       # (S, W)
+    # physical destination per (slot, column); invalid columns and
+    # cursors at/past the window write to trash (paged_decode_step's
+    # exact rule, widened)
+    dest = jnp.where(
+        valid & (pos // ps < n_p),
+        jnp.take_along_axis(page_table, jnp.minimum(pos // ps, n_p - 1),
+                            axis=1),
+        trash)
+    offset = pos % ps
+    pos_ids = jnp.minimum(pos, cfg.max_len - 1)
+    x = params["embed"][tokens] + params["pos"][pos_ids]   # (S, W, d)
+    # per-query causal mask over the logical window: column j sees
+    # key positions <= lengths + j
+    mask = jnp.arange(window)[None, None, :] <= pos[:, :, None]
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    new_layers = []
+    for p, layer in zip(params["blocks"], pool.layers):
+        h = _layer_norm(p["ln1"], x)
+        q = _heads(h, p["Wq"], cfg)                    # (S, H, W, hd)
+        k_new = _heads(h, p["Wk"], cfg)
+        v_new = _heads(h, p["Wv"], cfg)
+        # advanced indices (S, W) land in front: value is (S, W, H, hd)
+        ks = layer["k"].at[dest, :, offset, :].set(
+            k_new.transpose(0, 2, 1, 3).astype(layer["k"].dtype))
+        vs = layer["v"].at[dest, :, offset, :].set(
+            v_new.transpose(0, 2, 1, 3).astype(layer["v"].dtype))
+        if kernel == "pallas":
+            # one streamed single-query pass per column, each at its
+            # own cursor — garbage lanes (invalid columns) stay finite
+            # and are never read by the host
+            cols = []
+            for j in range(w):
+                lj = jnp.minimum(lengths + j, window - 1)
+                cols.append(paged_attention(
+                    q[:, :, j, :], ks, vs, page_table, lj,
+                    interpret=cfg.interpret))
+            att = jnp.stack(cols, axis=2)              # (S, H, W, hd)
+            att = att.astype(x.dtype).transpose(0, 2, 1, 3).reshape(
+                s, w, d)
+        else:
+            kg = ks[page_table].transpose(0, 2, 1, 3, 4).reshape(
+                s, cfg.n_heads, window, hd)
+            vg = vs[page_table].transpose(0, 2, 1, 3, 4).reshape(
+                s, cfg.n_heads, window, hd)
+            sc = jnp.einsum("shqd,shkd->shqk", q.astype(jnp.float32),
+                            kg.astype(jnp.float32)) * scale
+            sc = jnp.where(mask[:, None, :, :], sc, NEG_INF)
+            wts = jax.nn.softmax(sc, axis=-1)
+            att = jnp.einsum("shqk,shkd->shqd", wts,
+                             vg.astype(jnp.float32))
+            att = att.astype(x.dtype).transpose(0, 2, 1, 3).reshape(
+                s, w, d)
+        x = x + att @ p["Wo"]
+        x = _ffn(p, x)
+        new_layers.append({"k": ks, "v": vs})
+    x = _layer_norm(params["ln_f"], x)
+    logits = x @ params["embed"].T                     # (S, W, vocab)
+    return logits, PagedKVPool(tuple(new_layers))
 
 
 def paged_decode_step(params, tokens, pool: PagedKVPool, page_table,

@@ -79,6 +79,23 @@ class PrefixIndex:
         """View of every page the index retains."""
         return self._by_page.keys()
 
+    def iter_sequences(self):
+        """Yield every MAXIMAL cached token sequence (root-to-leaf token
+        path, one flat list per leaf), most recently touched leaf
+        first. This is the corpus view the prompt-lookup drafter feeds
+        on (serving/speculation.NgramDrafter): the trie already retains
+        the recent prompt population, so speculative decoding gets its
+        n-gram source for free — no second index, no device reads."""
+        leaves = [n for n in self._by_page.values() if not n.children]
+        leaves.sort(key=lambda n: n.tick, reverse=True)
+        for leaf in leaves:
+            parts: List[_Chunk] = []
+            node: Optional[_Node] = leaf
+            while node is not None:
+                parts.append(node.chunk)
+                node = node.parent
+            yield [t for chunk in reversed(parts) for t in chunk]
+
     def _chunks(self, tokens: Sequence[int]) -> List[_Chunk]:
         ps = self.page_size
         return [tuple(int(t) for t in tokens[j * ps:(j + 1) * ps])

@@ -107,12 +107,15 @@ class ReplicaSet:
         with self._checkout() as engine:
             return engine.generate(prompt, n_tokens)
 
-    def generate_stream(self, prompt, max_tokens: int, eos_id=None):
+    def generate_stream(self, prompt, max_tokens: int, eos_id=None,
+                        speculation: bool = True):
         """Submit one prompt to a replica's continuous-batching decode
         loop: least loop pressure (queued + occupied slots) wins, with
         the same shared round-robin cursor as `infer` breaking ties —
         so concurrent generate traffic fans across chips toward the
-        idlest loop, without coalescing delays."""
+        idlest loop, without coalescing delays. `speculation=False`
+        opts the request out of speculative drafting on loops that
+        have it on (bit-identical output either way)."""
         loops = [i for i, e in enumerate(self.engines)
                  if e.decode_loop is not None]
         if not loops:
@@ -122,7 +125,8 @@ class ReplicaSet:
         idx = self._select(
             loops, load_of=lambda i: self.engines[i].decode_loop.load)
         return self.engines[idx].generate_stream(prompt, max_tokens,
-                                                 eos_id)
+                                                 eos_id,
+                                                 speculation=speculation)
 
     def warmup(self, feature_shape, **kw) -> None:
         for engine in self.engines:
@@ -172,6 +176,24 @@ class ReplicaSet:
                          checkpoint={"path": os.path.abspath(path),
                                      "step": info.get("step", step)})
         return info
+
+    def load_draft_params(self, params, *, checkpoint=None) -> None:
+        """Swap the speculative draft model's weights on every replica
+        whose decode loop runs a model drafter (the `/reload`
+        `{"target": "draft"}` canary path). Raises when NO replica has
+        a draft model — a canary that silently reloaded nothing must
+        not report success."""
+        loaded = 0
+        for engine in self.engines:
+            loop = engine.decode_loop
+            if (loop is not None and loop._drafter is not None
+                    and loop._drafter.kind == "model"):
+                engine.load_draft_params(params, checkpoint=checkpoint)
+                loaded += 1
+        if not loaded:
+            raise ValueError(
+                "no replica runs a model drafter (serve with "
+                "speculation > 0 and drafter='model')")
 
     # ---------------------------------------------------- observability
     @property

@@ -102,7 +102,7 @@ def _parse_continuation(data: dict):
     keeps, or return None when the body doesn't speak the decode-loop
     contract (the router then degrades to the legacy blind passthrough
     and the replica's own validation answers). Returns
-    (rows, eos_id, prefix_cache)."""
+    (rows, eos_id, prefix_cache, speculation)."""
     try:
         raw = data["prompt"]
         if not isinstance(raw, list) or not raw:
@@ -131,7 +131,8 @@ def _parse_continuation(data: dict):
         eos = None if eos is None else int(eos)
         rows = [_RowState(i, p, m)
                 for i, (p, m) in enumerate(zip(prompts, per_row))]
-        return rows, eos, bool(data.get("prefix_cache", True))
+        return (rows, eos, bool(data.get("prefix_cache", True)),
+                bool(data.get("speculation", True)))
     except (TypeError, ValueError, KeyError):
         return None
 
@@ -419,7 +420,7 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             relayed, a total failure can still answer a clean 502."""
             import http.client as _hc
 
-            rows, eos_id, use_prefix = parsed
+            rows, eos_id, use_prefix, use_spec = parsed
             replica_errs = (OSError, _hc.HTTPException)
             failed = []        # replica ids excluded from resume placement
             resumes = 0        # successful re-admissions (stream opened)
@@ -547,6 +548,10 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                                    for r in pending],
                     "stream": True,
                     "prefix_cache": use_prefix,
+                    # the client's speculation opt-in/out survives the
+                    # failover hop (output is bit-identical either way —
+                    # this preserves intent, not correctness)
+                    "speculation": use_spec,
                     # absolute indices resume where delivery stopped, so
                     # dedupe below is a pure integer comparison
                     "token_index_base": [len(r.delivered)

@@ -212,6 +212,36 @@ class ServingHandle:
         _M_RELOADS.inc()
         return info
 
+    def load_draft_checkpoint(self, path: str,
+                              step: Optional[int] = None) -> dict:
+        """Hot-swap the speculative DRAFT model's weights from a
+        checkpoint (sharded dir with a `params` payload) — the
+        `/reload {"target": "draft"}` canary path. Serving weights and
+        their checkpoint identity are untouched; a draft swap can only
+        move acceptance rate, never output bits."""
+        import os
+
+        from deeplearning4j_tpu.checkpoint.restore import \
+            load_payload_tree
+
+        if self.generate_engine is None:
+            raise ValueError("no generate engine configured")
+        payload, manifest = load_payload_tree(path, step)
+        params = (payload["params"]
+                  if isinstance(payload, dict) and "params" in payload
+                  else payload)
+        info = {"path": os.path.abspath(path),
+                "step": manifest.get("step", step)}
+        self.generate_engine.load_draft_params(params, checkpoint=info)
+        self.last_reload = {
+            "path": path,
+            "step": info["step"],
+            "target": "draft",
+            "at": time.time(),
+        }
+        _M_RELOADS.inc()
+        return info
+
 
 def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                   generate_engine: Optional[InferenceEngine] = None,
@@ -223,6 +253,11 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                   max_waiting: Optional[int] = None,
                   prefix_cache: bool = True,
                   decode_kernel: str = "auto",
+                  horizon: int = 1,
+                  speculation: int = 0,
+                  drafter: str = "ngram",
+                  draft_params=None, draft_cfg=None,
+                  draft_window: int = 32,
                   host: str = "127.0.0.1", port: int = 0,
                   warmup_shape=None,
                   warmup_async: bool = False,
@@ -248,7 +283,13 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
     individual requests opt out with `"prefix_cache": false` in the
     /generate body. `decode_kernel` picks the decode attention lane
     ("auto" = Pallas paged kernel on TPU, dense gather elsewhere;
-    docs/SERVING.md "Decode kernel"). `checkpoint` ({path, step})
+    docs/SERVING.md "Decode kernel"). `horizon > 1` chains K decode
+    steps per dispatch; `speculation = k > 0` turns on draft-and-verify
+    speculative decoding instead (`drafter` "ngram" or "model" with
+    `draft_params`/`draft_cfg`; requests opt out with
+    `"speculation": false` in the /generate body and the reload route
+    accepts `{"target": "draft"}` to canary new draft weights —
+    docs/SERVING.md "Speculative decoding"). `checkpoint` ({path, step})
     stamps the initial checkpoint identity on the replicas when the
     served model came from a checkpoint — /readyz, /stats, and the
     fleet journal report it (docs/PIPELINE.md).
@@ -275,7 +316,13 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                                           n_pages=kv_pages,
                                           max_waiting=max_waiting,
                                           prefix_cache=prefix_cache,
-                                          kernel=decode_kernel)
+                                          kernel=decode_kernel,
+                                          horizon=horizon,
+                                          speculation=speculation,
+                                          drafter=drafter,
+                                          draft_params=draft_params,
+                                          draft_cfg=draft_cfg,
+                                          draft_window=draft_window)
     batcher = replicas.batcher(max_batch_size=max_batch_size,
                                max_delay_ms=max_delay_ms,
                                max_queue=max_queue)
@@ -436,8 +483,26 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
             if not path:
                 raise ValueError("reload needs {'path': <checkpoint>}")
             step = data.get("step")
-            info = handle.load_checkpoint(
-                str(path), step=None if step is None else int(step))
+            step = None if step is None else int(step)
+            target = data.get("target", "serving")
+            if target == "draft":
+                # canary path for the speculative draft model: swap
+                # ONLY the drafter's weights; serving weights and
+                # checkpoint identity are untouched
+                info = handle.load_draft_checkpoint(str(path), step=step)
+                self._reply(200, {
+                    "reloaded": True,
+                    "target": "draft",
+                    "step": info.get("step"),
+                    "replicas": len(replicas.engines),
+                    "checkpoint": replicas.checkpoint,
+                })
+                return
+            if target != "serving":
+                raise ValueError(
+                    f"reload target must be 'serving' or 'draft', "
+                    f"got {target!r}")
+            info = handle.load_checkpoint(str(path), step=step)
             self._reply(200, {
                 "reloaded": True,
                 "step": info.get("step"),
@@ -485,6 +550,9 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
             # per-request opt-out: a secret-bearing prompt must neither
             # read from nor seed the shared prefix cache
             use_prefix = bool(data.get("prefix_cache", True))
+            # per-request speculation opt-out (no-op on loops without
+            # speculation; output is bit-identical either way)
+            use_spec = bool(data.get("speculation", True))
             loop = generate_engine.decode_loop
             if loop is None:
                 # legacy per-request compiled-scan path (no slot
@@ -511,7 +579,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
             streams = loop.submit_many(prompt, max_tokens, eos_id,
                                        deadline=deadline,
                                        prefix_cache=use_prefix,
-                                       token_index_base=base)
+                                       token_index_base=base,
+                                       speculation=use_spec)
             if streaming:
                 self._stream_tokens(streams, deadline)
                 return

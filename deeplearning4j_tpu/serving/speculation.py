@@ -1,0 +1,205 @@
+"""Drafters for speculative decoding on the deterministic decode lane.
+
+The decode step is bandwidth-bound: every delivered token pays one full
+weight + KV-page sweep. Because the whole serving stack is
+deterministic-argmax end to end, the classic draft-and-verify trick
+(Leviathan et al.'s speculative decoding, here in its greedy/exact
+form) costs nothing in output quality: a cheap drafter proposes k
+continuation tokens per slot, ONE widened verify dispatch scores all
+k+1 positions against the target model, and the accepted prefix is the
+longest run where the draft agrees with the target's own argmax — with
+the first disagreement replaced by the target's token. Output is
+bit-identical to non-speculative decode by construction; only the
+number of target dispatches per delivered token changes.
+
+Two drafter flavors, selected by `DecodeLoop(drafter=...)`:
+
+- `NgramDrafter` ("ngram") — zero weights, pure host-side prompt
+  lookup: the longest n-gram suffix of the slot's own history (prompt +
+  everything generated so far) is searched backwards in that history,
+  and on a miss in the corpus of recent prompts the prefix-cache trie
+  already retains (`PrefixIndex.iter_sequences`). Chat-shaped traffic
+  — templated prompts, multi-turn replays, the repetitive continuations
+  greedy tiny models settle into — makes this surprisingly strong, and
+  it ships with no extra HBM or checkpoint.
+- `ModelDrafter` ("model") — a small draft transformer (its own
+  `TransformerConfig` + params) proposing k greedy tokens from a fixed
+  right-aligned token window. The whole fleet ships it through the same
+  checkpoint `/reload` path as the target (`target: "draft"`), so the
+  deployment pipeline can canary a new draft model without touching
+  serving weights. One jitted scan program, fixed `(S, window)` shape —
+  the drafter adds exactly one compiled program for the server's life.
+
+A drafter only ever *proposes*; `DecodeLoop`'s verify step is the sole
+authority on what gets emitted. A bad drafter costs acceptance rate
+(visible as dl4j_spec_accepted / dl4j_spec_proposed), never
+correctness.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["NgramDrafter", "ModelDrafter", "build_drafter"]
+
+
+class NgramDrafter:
+    """Prompt-lookup drafter: propose the continuation that followed the
+    most recent earlier occurrence of the history's n-gram suffix.
+
+    Search order per suffix length n (longest first, down to 1):
+    the slot's OWN history (most recent occurrence wins — self-repeating
+    greedy continuations and multi-turn replays hit here), then the
+    shared corpus (`corpus()` — the prefix-cache trie's retained prompt
+    sequences, most recently inserted first). Zero device state."""
+
+    kind = "ngram"
+
+    def __init__(self, ngram: int = 3,
+                 corpus: Optional[Callable[[], Iterable[Sequence[int]]]]
+                 = None):
+        if ngram < 1:
+            raise ValueError(f"ngram must be >= 1, got {ngram}")
+        self.ngram = int(ngram)
+        self._corpus = corpus
+
+    @staticmethod
+    def _lookup(seq: Sequence[int], suffix: List[int],
+                k: int) -> Optional[List[int]]:
+        """Continuation after the most recent occurrence of `suffix` in
+        `seq` that has a FULL k-token continuation; an occurrence with a
+        shorter (but non-empty) continuation is kept only as fallback.
+        The distinction matters for exactly the histories this drafter
+        lives on: a self-repeating greedy tail's LAST occurrence sits at
+        the end of the history where only ~1 follower exists, while an
+        occurrence one period earlier yields the same loop k tokens
+        deep — proposing 1 token/round where k fit would forfeit most
+        of the verify round's amortization. (The trivial match at the
+        very end has no followers at all and never fires.)"""
+        n = len(suffix)
+        best = None
+        for i in range(len(seq) - n, -1, -1):
+            if i + n < len(seq) and list(seq[i:i + n]) == suffix:
+                cont = [int(t) for t in seq[i + n:i + n + k]]
+                if len(cont) == k:
+                    return cont
+                if best is None:
+                    best = cont
+        return best
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        """Up to k proposed continuation tokens for `history` (possibly
+        fewer, possibly none — the verify round simply narrows)."""
+        if k < 1 or len(history) < 2:
+            return []
+        history = [int(t) for t in history]
+        for n in range(min(self.ngram, len(history) - 1), 0, -1):
+            suffix = history[-n:]
+            hit = self._lookup(history, suffix, k)
+            if hit:
+                return hit
+            if self._corpus is not None:
+                for seq in self._corpus():
+                    hit = self._lookup(list(seq), suffix, k)
+                    if hit:
+                        return hit
+        return []
+
+
+class ModelDrafter:
+    """Small draft transformer proposing k greedy tokens per slot.
+
+    `propose_all(windows, k)` takes the right-aligned `(S, window)`
+    token batch (left zero-padding for short histories) and rolls the
+    window k times through ONE jitted `lax.scan`: each step takes the
+    argmax at the last column and shifts it in. Shapes are fixed at
+    construction, so the drafter compiles exactly one program — the
+    `decode_step_programs <= 2` pin stays honest (the draft program is
+    counted separately via `draft_programs()`).
+
+    The left padding / window-relative positions can only hurt draft
+    QUALITY (acceptance rate), never correctness — the target-model
+    verify step is the only thing that decides emitted tokens."""
+
+    kind = "model"
+
+    def __init__(self, params, cfg, *, window: int = 32):
+        if window < 1:
+            raise ValueError(f"draft window must be >= 1, got {window}")
+        self.cfg = cfg
+        self.params = params
+        self.window = int(min(window, cfg.max_len))
+        self._draft = None  # built lazily — import jax only when used
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.models.transformer import \
+            transformer_logits
+
+        cfg = self.cfg
+
+        def draft_fn(params, window, k):
+            def step(win, _):
+                logits = transformer_logits(params, win, cfg)
+                nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(
+                    jnp.int32)
+                win = jnp.concatenate([win[:, 1:], nxt[:, None]],
+                                      axis=1)
+                return win, nxt
+
+            _, toks = jax.lax.scan(step, window, None, length=k)
+            return toks.T  # (S, k)
+
+        self._draft = jax.jit(draft_fn, static_argnums=2)
+
+    def propose_all(self, windows: np.ndarray, k: int) -> np.ndarray:
+        """(S, window) int32 right-aligned histories -> (S, k) int32
+        proposals. Rows the caller doesn't need are computed anyway
+        (fixed shape) and ignored."""
+        import jax.numpy as jnp
+
+        if self._draft is None:
+            self._build()
+        return np.asarray(self._draft(self.params,
+                                      jnp.asarray(windows, jnp.int32),
+                                      int(k)))
+
+    def draft_programs(self) -> int:
+        """Compiled draft programs (0 until first use, then pinned 1)."""
+        from deeplearning4j_tpu.utils.jitcache import jit_cache_size
+
+        if self._draft is None:
+            return 0
+        return jit_cache_size(self._draft)
+
+    def load_params(self, params) -> None:
+        """Swap the draft weights (same single-reference-assignment
+        contract as the target's hot reload; shapes validated by the
+        caller via checkpoint.restore.validate_like)."""
+        self.params = params
+
+
+def build_drafter(drafter: str, *, k: int, cfg, draft_params=None,
+                  draft_cfg=None, draft_window: int = 32,
+                  ngram: int = 3, corpus=None):
+    """Construct the drafter `DecodeLoop(speculation=k, drafter=...)`
+    asked for, validating the pieces it needs."""
+    if drafter == "ngram":
+        return NgramDrafter(ngram=ngram, corpus=corpus)
+    if drafter == "model":
+        if draft_params is None or draft_cfg is None:
+            raise ValueError(
+                "drafter='model' needs draft_params= and draft_cfg= "
+                "(a small TransformerConfig + its weights)")
+        if draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"draft model vocab_size {draft_cfg.vocab_size} != "
+                f"target vocab_size {cfg.vocab_size} — proposed token "
+                "ids must be target-vocabulary ids")
+        return ModelDrafter(draft_params, draft_cfg, window=draft_window)
+    raise ValueError(
+        f"drafter must be 'ngram' or 'model', got {drafter!r}")

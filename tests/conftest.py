@@ -68,6 +68,11 @@ def pytest_configure(config):
         "(deploy/controller.py conveyor: watch -> eval gate -> canary "
         "promote -> rollback); the in-process drills run in tier-1 — "
         "run the whole layer with pytest -m pipeline")
+    config.addinivalue_line(
+        "markers",
+        "spec: speculative-decoding lane (serving/speculation.py + the "
+        "DecodeLoop draft-and-verify dispatch); deterministic drills "
+        "run in tier-1 — run just this layer with pytest -m spec")
 
 
 def pytest_collection_modifyitems(config, items):

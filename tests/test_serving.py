@@ -418,7 +418,8 @@ class TestReplicaSet:
                 self.decode_loop = loop
                 self.streams = 0
 
-            def generate_stream(self, prompt, max_tokens, eos_id=None):
+            def generate_stream(self, prompt, max_tokens, eos_id=None,
+                                speculation=True):
                 self.streams += 1
                 return f"stream-{id(self)}"
 

@@ -120,7 +120,8 @@ class _Pair:
     """N in-process replicas serving the SAME transformer weights
     behind a router — the interchangeability the failover leans on."""
 
-    def __init__(self, tf_setup, n=2, prefix_cache=True, **fleet_kw):
+    def __init__(self, tf_setup, n=2, prefix_cache=True, serve_kw=None,
+                 **fleet_kw):
         params, cfg = tf_setup
         self.handles = []
         for _ in range(n):
@@ -129,7 +130,7 @@ class _Pair:
             self.handles.append(serve_network(
                 _net(), n_replicas=1, max_delay_ms=1.0,
                 generate_engine=gen, slots=4, page_size=8,
-                prefix_cache=prefix_cache))
+                prefix_cache=prefix_cache, **dict(serve_kw or {})))
         fleet_kw.setdefault("heartbeat_timeout", 5.0)
         self.fleet = Fleet(start=False, **fleet_kw)
         for h in self.handles:
@@ -354,6 +355,81 @@ class TestMidStreamFailover:
             pair.close()
 
 
+# ============== decode-lane variants: horizon chaining + speculation
+class TestDecodeLaneFailover:
+    """The drills above run the plain one-token decode lane. The
+    durable-stream contract must hold UNCHANGED when the replica's lane
+    batches (horizon>1 chains K decode steps per dispatch, tokens land
+    in bursts) or speculates (draft-and-verify emits 1..k+1 tokens per
+    verify round): resume is ordinary admission either way, every chunk
+    still carries its absolute `token_index`, and greedy argmax keeps
+    the continuation bit-identical to an uninterrupted run."""
+
+    BODY = {"prompt": [[1, 2, 3, 4]], "max_tokens": 12, "stream": True}
+
+    def _drill(self, tf_setup, serve_kw, programs_max=1):
+        pair = _Pair(tf_setup, serve_kw=serve_kw)
+        try:
+            ref = _stream(f"{pair.url}/generate", self.BODY)
+            ref_toks = [e["token"] for e in _token_events(ref)]
+            assert len(ref_toks) == 12
+            # reset at chunk 3: MID-window for horizon=4 (burst
+            # boundary is 4) and mid-round for speculation — the
+            # delivered prefix ends at a point the lane never chose
+            chaos.configure([Rule("generate.midstream", "reset",
+                                  at=[3])])
+            out = _stream(f"{pair.url}/generate", self.BODY)
+            chaos.deactivate()
+            toks = _token_events(out)
+            assert [e["token"] for e in toks] == ref_toks
+            assert [e["token_index"] for e in toks] == list(range(12))
+            assert out[-1]["done"] and out[-1]["resumes"] == 1
+            assert out[-1]["tokens"] == ref[-1]["tokens"]
+            decs = pair.decode_stats()
+            assert all(d["decode_step_programs"] <= programs_max
+                       for d in decs)
+            return decs
+        finally:
+            pair.close()
+
+    def test_horizon_chain_resume_bit_identical(self, tf_setup):
+        """horizon=4: the victim dies mid-burst (3 of 12 delivered, not
+        a multiple of the horizon) — the survivor re-admits
+        prompt+delivered and its own burst grid restarts from there,
+        proving the chain carries no hidden per-window state."""
+        decs = self._drill(tf_setup, {"horizon": 4})
+        assert all(d["horizon"] == 4 for d in decs)
+
+    def test_speculative_resume_bit_identical(self, tf_setup):
+        """speculation=4 (ngram drafter): accept lengths are
+        data-dependent, so the resumed continuation retraces the SAME
+        tokens through a different accept pattern — the absolute
+        token_index contract is what keeps the client stream gapless."""
+        decs = self._drill(tf_setup, {"speculation": 4},
+                           programs_max=2)
+        assert all(d["speculation"]["enabled"] for d in decs)
+        # speculation actually engaged on the serving path
+        assert sum(d["speculation"]["rounds"] for d in decs) >= 1
+
+    def test_speculative_nonstream_multirow_resume(self, tf_setup):
+        """The non-streaming multi-row recovery (rows buffered by the
+        router, unfinished rows resumed) with speculation on: aggregated
+        rows and finish_reasons match the uninterrupted reference."""
+        pair = _Pair(tf_setup, serve_kw={"speculation": 4})
+        body = {"prompt": [[1, 2, 3], [4, 5, 6, 7]], "max_tokens": 6}
+        try:
+            ref = _post(f"{pair.url}/generate", body)
+            chaos.configure([Rule("generate.midstream", "reset",
+                                  at=[5])])
+            out = _post(f"{pair.url}/generate", body)
+            chaos.deactivate()
+            assert out["tokens"] == ref["tokens"]
+            assert out["finish_reasons"] == ref["finish_reasons"]
+            assert out["resumes"] >= 1
+        finally:
+            pair.close()
+
+
 # ============================ exactly-once dedupe against a noisy stub
 class TestExactlyOnceDedupe:
     def test_duplicate_token_indices_relayed_once(self):
@@ -425,7 +501,7 @@ class TestExactlyOnceDedupe:
 
 
 # ===================== real processes: SIGKILL / SIGSTOP stream drills
-def _spawner(tmp_path, slow_ms=40):
+def _spawner(tmp_path, slow_ms=40, extra=()):
     """Replica processes serving /generate from `--transformer SPEC`:
     deterministic init means every process carries bit-identical
     weights. A chaos delay on each streamed chunk paces token emission
@@ -450,7 +526,8 @@ def _spawner(tmp_path, slow_ms=40):
                           serve_args=["--max-delay-ms", "1",
                                       "--transformer", spec,
                                       "--slots", "4",
-                                      "--page-size", "8"],
+                                      "--page-size", "8",
+                                      *extra],
                           env=env)
 
 
@@ -506,14 +583,8 @@ class TestProcessDrills:
             total_resumes += done["resumes"]
         return total_resumes
 
-    def test_sigkill_mid_stream_zero_client_failures(self, tmp_path):
-        """ISSUE acceptance drill: SIGKILL the serving replica while
-        concurrent streams are mid-flight — zero client-visible
-        failures, every stream gapless/duplicate-free and
-        bit-identical to the uninterrupted reference, resume counters
-        scraped off the live /metrics, and the survivor never compiled
-        a second decode program."""
-        fleet = Fleet(spawner=_spawner(tmp_path),
+    def _sigkill_drill(self, tmp_path, extra=(), programs_max=1):
+        fleet = Fleet(spawner=_spawner(tmp_path, extra=extra),
                       heartbeat_interval=0.2, heartbeat_timeout=3.0,
                       breaker_threshold=2, breaker_reset_s=0.4)
         router = None
@@ -553,8 +624,9 @@ class TestProcessDrills:
             assert scraped["dl4j_fleet_stream_tokens_replayed_total"] \
                 >= len(self.PROMPT)
 
-            # the survivor: resume was ordinary admission (ONE decode
-            # program) and every page came back
+            # the survivor: resume was ordinary admission (no extra
+            # programs past the lane's pinned budget) and every page
+            # came back
             survivor = next(r for r in fleet._replicas.values()
                             if r.id != victim.id)
             deadline = time.monotonic() + 10.0
@@ -564,12 +636,41 @@ class TestProcessDrills:
                     break
                 time.sleep(0.1)
             assert dec["pages_in_use"] == 0
-            assert dec["decode_step_programs"] == 1
+            assert dec["decode_step_programs"] <= programs_max
+            return dec
         finally:
             if router is not None:
                 router.close(stop_replicas=True)
             else:
                 fleet.close(stop_replicas=True)
+
+    def test_sigkill_mid_stream_zero_client_failures(self, tmp_path):
+        """ISSUE acceptance drill: SIGKILL the serving replica while
+        concurrent streams are mid-flight — zero client-visible
+        failures, every stream gapless/duplicate-free and
+        bit-identical to the uninterrupted reference, resume counters
+        scraped off the live /metrics, and the survivor never compiled
+        a second decode program."""
+        dec = self._sigkill_drill(tmp_path)
+        assert dec["decode_step_programs"] == 1
+
+    def test_sigkill_mid_horizon_stream(self, tmp_path):
+        """The same SIGKILL drill with `cli serve --horizon 4`: the kill
+        lands mid-burst at an arbitrary window offset, and the resumed
+        stream is still gapless (absolute token_index) and bit-identical
+        — the horizon chain carries no state a failover could lose."""
+        dec = self._sigkill_drill(tmp_path, extra=("--horizon", "4"))
+        assert dec["horizon"] == 4
+
+    def test_sigkill_mid_speculative_stream(self, tmp_path):
+        """And with `cli serve --speculation 4`: accept lengths are
+        data-dependent per round, so victim and survivor take different
+        accept paths through the SAME token sequence — bit-identity and
+        exactly-once delivery must survive that."""
+        dec = self._sigkill_drill(tmp_path,
+                                  extra=("--speculation", "4"),
+                                  programs_max=2)
+        assert dec["speculation"]["enabled"]
 
     def test_sigstop_breaker_eviction_resumes_and_frees_pages(
             self, tmp_path):
