@@ -1593,6 +1593,222 @@ def bench_stream_failover():
         shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_slo_tiers():
+    """SLO tiers drill (docs/SERVING.md "Priority tiers"): saturate a
+    fleet's decode slots with batch-tier /generate streams, then run
+    interactive requests through the flood. Interactive latency must
+    hold (preemption evicts batch slots past the fair share), and the
+    preempted batch work must be LOSSLESS: the router's durable-stream
+    resume re-admits each preempted row, so every batch stream still
+    delivers its full token budget gapless, duplicate-free, and
+    bit-identical to a calm reference. Gates: bounded interactive p99
+    vs the calm baseline, zero lost/duplicated batch rows, at least
+    one observed preemption, and the three-way page-pool invariant
+    intact at the end."""
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
+    from deeplearning4j_tpu.serving.fleet import Fleet, ReplicaSpawner
+    from deeplearning4j_tpu.serving.router import serve_fleet
+    from deeplearning4j_tpu.testing import chaos as chaos_mod
+
+    fast = _fast()
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(4).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=3)
+            .pretrain(False).build())
+    work = tempfile.mkdtemp(prefix="dl4j_bench_slo_")
+    ckpt = os.path.join(work, "slo.ckpt")
+    DefaultModelSaver(ckpt, keep_old=False).save(MultiLayerNetwork(conf))
+    spec = os.path.join(work, "tf.json")
+    with open(spec, "w") as f:
+        _json.dump({"vocab_size": 17, "d_model": 32, "n_heads": 2,
+                    "n_layers": 2, "d_ff": 64, "max_len": 96,
+                    "interpret": fast,
+                    "seed": 0}, f)
+    # pace the decode scheduler itself so interactive arrivals land
+    # while batch streams HOLD slots: with the compile cache hot a
+    # replica decodes ~2 ms/token, and an unpaced flood frees every
+    # slot before a probe can arrive — decode.step is the chaos point
+    # at the top of every scheduler pass
+    delay_s = 0.01 if fast else 0.02
+    step_s = 0.03 if fast else 0.05
+    env = dict(os.environ,
+               **chaos_mod.env_spec([
+                   chaos_mod.Rule("generate.midstream", "delay",
+                                  delay_s=delay_s),
+                   chaos_mod.Rule("decode.step", "delay",
+                                  delay_s=step_s)]))
+    # 4 slots, batch_share 0.5: an idle fleet lets batch take all 4,
+    # and the first interactive arrival preempts down toward 2
+    spawner = ReplicaSpawner(
+        ckpt, serve_args=["--max-delay-ms", "1", "--transformer", spec,
+                          "--slots", "4", "--page-size", "8",
+                          "--batch-share", "0.5"],
+        env=env)
+
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    batch_tokens = 48 if fast else 64
+    inter_tokens = 4
+    n_batch_streams = 4
+    n_probes = 12 if fast else 24
+
+    def p99(xs):
+        return (sorted(xs)[max(0, int(len(xs) * 0.99) - 1)]
+                if xs else None)
+
+    def interactive_once():
+        body = _json.dumps({"prompt": [prompt],
+                            "max_tokens": inter_tokens}).encode()
+        req = urllib.request.Request(
+            f"{router.url}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=300) as r:
+            reply = _json.loads(r.read())
+        assert "tokens" in reply, reply
+        return time.perf_counter() - t0, reply["tokens"][0]
+
+    def batch_stream(events):
+        body = _json.dumps({"prompt": [prompt],
+                            "max_tokens": batch_tokens,
+                            "priority": "batch",
+                            "stream": True}).encode()
+        req = urllib.request.Request(
+            f"{router.url}/generate", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-Priority": "batch"})
+        with urllib.request.urlopen(req, timeout=300) as r:
+            for ln in r:
+                if ln.strip():
+                    events.append(_json.loads(ln))
+
+    fleet = Fleet(spawner=spawner, heartbeat_interval=0.2,
+                  heartbeat_timeout=3.0, shed_high_water=64)
+    router = None
+    try:
+        fleet.spawn(1)
+        fleet.wait_ready(1, timeout=300)
+        router = serve_fleet(fleet)
+
+        # calm baseline: compile the decode path, take the reference
+        # continuation (deterministic weights: tier never changes the
+        # tokens), then measure undisturbed interactive latency
+        _, ref_inter = interactive_once()
+        ref_events = []
+        batch_stream(ref_events)
+        ref_batch = [e["token"] for e in ref_events if "token" in e]
+        assert len(ref_batch) == batch_tokens
+        calm = [interactive_once()[0] for _ in range(n_probes)]
+        calm_p99 = p99(calm)
+
+        # flood: saturate every slot with batch streams, then push the
+        # interactive probes through the flood
+        all_events = [[] for _ in range(n_batch_streams)]
+        errors = []
+
+        def worker(i):
+            try:
+                batch_stream(all_events[i])
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    daemon=True)
+                   for i in range(n_batch_streams)]
+        for t in threads:
+            t.start()
+        # wait until the flood actually OCCUPIES every decode slot
+        # (router-side outstanding also counts relay-lagged streams)
+        rep0 = next(iter(fleet._replicas.values()))
+        occupy_by = time.monotonic() + 30.0
+        while time.monotonic() < occupy_by:
+            occ = rep0.client.stats()["generate"]["decode"][
+                "tiers"]["occupied"]
+            if occ["batch"] >= n_batch_streams:
+                break
+            time.sleep(0.02)
+        flood = []
+        util_peak = 0.0
+        for i in range(n_probes):
+            dt, toks = interactive_once()
+            flood.append(dt)
+            assert toks == ref_inter, "interactive tokens diverged"
+            util_peak = max(util_peak,
+                            fleet.snapshot()["tiers"]["utilization"])
+        flood_p99 = p99(flood)
+        for t in threads:
+            t.join(timeout=300)
+
+        # lossless batch lane: every stream full-length, gapless,
+        # duplicate-free, bit-identical to the calm reference
+        failures = list(errors)
+        resumes = 0
+        for ev in all_events:
+            toks = [e for e in ev if "token" in e]
+            if [e["token_index"] for e in toks] != list(
+                    range(batch_tokens)):
+                failures.append("batch token_index gap/dup")
+            if [e["token"] for e in toks] != ref_batch:
+                failures.append("batch tokens diverged from reference")
+            if not (ev and ev[-1].get("done")):
+                failures.append("batch stream ended without done")
+            else:
+                resumes += ev[-1].get("preempt_resumes", 0)
+
+        snap = fleet.snapshot()
+        rep = next(iter(fleet._replicas.values()))
+        sdec = rep.client.stats()["generate"]["decode"]
+        preemptions = sdec["tiers"]["preemptions"]
+        pages_leaked = sdec["pages_in_use"]  # all streams done by now
+        bound = max(1.5 * calm_p99, 2.0) if calm_p99 else 2.0
+        return {
+            "value": round(flood_p99 * 1e3, 2) if flood_p99 else None,
+            "unit": "interactive_p99_under_flood_ms",
+            "lower_is_better": True,
+            "batch_streams": n_batch_streams,
+            "batch_tokens_per_stream": batch_tokens,
+            "interactive_probes": n_probes,
+            "calm_p99_ms": (round(calm_p99 * 1e3, 2)
+                            if calm_p99 else None),
+            "flood_p99_ms": (round(flood_p99 * 1e3, 2)
+                             if flood_p99 else None),
+            "p99_bound_ms": round(bound * 1e3, 2),
+            "preemptions": preemptions,
+            "preempt_resumes": snap["tiers"]["preempt_resumes"],
+            "client_preempt_resumes": resumes,
+            "batch_row_failures": len(failures),
+            "failure_sample": failures[:3],
+            "utilization_peak": round(util_peak, 4),
+            "tier_requests": snap["tiers"]["requests"],
+            "gate_interactive_p99_bounded": bool(
+                flood_p99 and flood_p99 <= bound),
+            "gate_zero_batch_loss": not failures,
+            "gate_preempted": preemptions >= 1,
+            "gate_lossless_resume":
+                snap["tiers"]["preempt_resumes"] >= 1,
+            "gate_no_leaked_pages": pages_leaked == 0,
+            "gate_one_decode_program":
+                sdec["decode_step_programs"] == 1,
+        }
+    finally:
+        if router is not None:
+            router.close(stop_replicas=True)
+        else:
+            fleet.close(stop_replicas=True)
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_train_elastic():
     """Self-healing elastic training drills (ISSUE 9,
     docs/FAULT_TOLERANCE.md "Supervisor runbook"). Three drills over a
@@ -2854,6 +3070,7 @@ CONFIGS = {
     "fleet": bench_fleet,
     "chaos": bench_chaos,
     "stream_failover": bench_stream_failover,
+    "slo_tiers": bench_slo_tiers,
     "train_elastic": bench_train_elastic,
     "controlplane": bench_controlplane,
     "pipeline": bench_pipeline,
@@ -2878,6 +3095,7 @@ METRIC_NAMES = {
     "fleet": "fleet_predict_rows_per_sec_4_replicas",
     "chaos": "chaos_sigstop_breaker_eviction_s",
     "stream_failover": "serving_stream_failover_p99_ttnt_ms",
+    "slo_tiers": "serving_interactive_p99_under_batch_flood_ms",
     "train_elastic": "train_elastic_kill_recovery_s",
     "controlplane": "controlplane_router_restart_recovery_s",
     "pipeline": "pipeline_commit_to_served_s",

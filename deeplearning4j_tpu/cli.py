@@ -443,6 +443,7 @@ def cmd_serve(args) -> int:
             max_batch_size=args.max_batch_size,
             max_delay_ms=args.max_delay_ms,
             max_queue=args.max_queue,
+            batch_share=args.batch_share,
             slots=args.slots, page_size=args.page_size,
             kv_pages=args.kv_pages,
             prefix_cache=args.prefix_cache,
@@ -479,6 +480,7 @@ def cmd_serve(args) -> int:
                           },
                           "prefix_cache": args.prefix_cache,
                           "slots": args.slots,
+                          "batch_share": args.batch_share,
                           "page_size": args.page_size,
                           "kv_pages": (loop.n_pages
                                        if loop is not None else None),
@@ -540,6 +542,7 @@ def cmd_fleet(args) -> int:
                   heartbeat_interval=args.heartbeat_interval,
                   heartbeat_timeout=args.heartbeat_timeout,
                   shed_high_water=args.shed_high_water,
+                  batch_high_water=args.batch_high_water,
                   request_timeout=args.request_timeout,
                   retry_budget=args.retry_budget,
                   stream_resume_attempts=args.stream_resume_attempts,
@@ -776,6 +779,190 @@ def cmd_eval(args) -> int:
     return 0
 
 
+def cmd_batch(args) -> int:
+    """`batch`: bulk generation through a router (or single replica) on
+    the BATCH SLO tier — the offline lane's reference client
+    (docs/SERVING.md "Priority tiers").
+
+    Reads a JSONL prompt file (each line a bare token list, or an
+    object {"prompt": [...], "max_tokens": N}), drives chunks of
+    --batch-size rows through ``POST /generate`` with
+    ``"priority": "batch"`` (plus the X-Priority header so routers
+    shed/forward without parsing the body), and appends one result
+    line per row to --output. Progress is crash-safe: rows are fsynced
+    to the output BEFORE the cursor journal (StateFile) commits, so a
+    killed client restarts exactly where it stopped — uncommitted tail
+    rows are truncated and re-run, committed rows are never re-emitted
+    (each input row lands in the output exactly once). A 503 shed is
+    waited out via the tier-aware ``retry_after_ms`` the shed reply
+    carries; slot preemptions never surface here at all — the router's
+    durable-stream resume replays them losslessly, and the reply's
+    `preempt_resumes` count is accumulated into the summary."""
+    import hashlib
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    from deeplearning4j_tpu.serving.errors import (PRIORITY_HEADER,
+                                                   TIER_BATCH)
+    from deeplearning4j_tpu.utils.statefile import StateFile
+
+    rows = []
+    with open(args.input, "rb") as f:
+        raw = f.read()
+    for ln, line in enumerate(raw.decode().splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        obj = json.loads(line)
+        if isinstance(obj, list):
+            rows.append((obj, args.max_tokens))
+        elif isinstance(obj, dict) and "prompt" in obj:
+            rows.append((obj["prompt"],
+                         int(obj.get("max_tokens", args.max_tokens))))
+        else:
+            print(f"{args.input}:{ln}: each line must be a token list "
+                  "or an object with \"prompt\"", file=sys.stderr)
+            return 2
+    if not rows:
+        print(f"{args.input}: no prompt rows", file=sys.stderr)
+        return 2
+    input_sha = hashlib.sha256(raw).hexdigest()
+
+    journal_path = args.journal or (args.output + ".journal")
+    journal = StateFile(journal_path)
+    state = journal.read()
+    cursor = 0
+    sheds_total = 0
+    preempts_total = 0
+    if state is not None:
+        if state.get("input_sha") != input_sha:
+            print(f"journal {journal_path} was committed against a "
+                  "DIFFERENT input file (sha mismatch); delete the "
+                  "journal (and the output) to start over",
+                  file=sys.stderr)
+            return 2
+        cursor = int(state.get("cursor", 0))
+        sheds_total = int(state.get("sheds", 0))
+        preempts_total = int(state.get("preempt_resumes", 0))
+    resumed_at = cursor
+
+    # reconcile the output against the committed cursor: rows past it
+    # were appended but never committed (crash between the output
+    # fsync and the journal write) — truncate so they re-run; fewer
+    # rows than the cursor promises means the pair was tampered with,
+    # and resuming would silently drop rows
+    if os.path.exists(args.output):
+        with open(args.output, "rb+") as out:
+            data = out.read()
+            ends = [i for i, b in enumerate(data) if b == 0x0A]
+            if len(ends) < cursor:
+                print(f"output {args.output} holds {len(ends)} rows "
+                      f"but the journal committed {cursor}; refusing "
+                      "to resume from an inconsistent pair",
+                      file=sys.stderr)
+                return 2
+            out.truncate(ends[cursor - 1] + 1 if cursor else 0)
+    elif cursor:
+        print(f"journal committed {cursor} rows but output "
+              f"{args.output} is missing; delete the journal to start "
+              "over", file=sys.stderr)
+        return 2
+
+    url = args.url.rstrip("/")
+    headers = {"Content-Type": "application/json",
+               PRIORITY_HEADER: TIER_BATCH}
+    start = _time.perf_counter()
+    out_f = open(args.output, "ab")
+    try:
+        while cursor < len(rows):
+            chunk = rows[cursor:cursor + args.batch_size]
+            body = {"prompt": [r[0] for r in chunk],
+                    "max_tokens": [r[1] for r in chunk],
+                    "priority": TIER_BATCH}
+            if args.eos_id is not None:
+                body["eos_id"] = args.eos_id
+            payload = json.dumps(body).encode()
+            sheds = 0
+            while True:
+                req = urllib.request.Request(url + "/generate",
+                                             data=payload,
+                                             headers=headers)
+                try:
+                    with urllib.request.urlopen(
+                            req, timeout=args.timeout) as r:
+                        reply = json.loads(r.read())
+                    break
+                except urllib.error.HTTPError as e:
+                    raw_err = e.read()
+                    if e.code == 503 and sheds < args.max_shed_retries:
+                        # the batch lane shed us (it sheds FIRST, at
+                        # its own lower high-water mark): wait out the
+                        # backlog-derived Retry-After and try again
+                        sheds += 1
+                        sheds_total += 1
+                        try:
+                            err = json.loads(raw_err)
+                        except ValueError:
+                            err = {}
+                        wait = min(5.0, max(
+                            0.05,
+                            float(err.get("retry_after_ms", 1000))
+                            / 1000.0))
+                        _time.sleep(wait)
+                        continue
+                    print(f"batch: /generate answered {e.code}: "
+                          f"{raw_err.decode(errors='replace')[:200]}",
+                          file=sys.stderr)
+                    return 3
+            if "error" in reply:
+                # a durable-stream router reports an exhausted resume
+                # budget in-band, not as a raw 5xx
+                print(f"batch: generation failed: {reply['error']}",
+                      file=sys.stderr)
+                return 3
+            toks = reply["tokens"]
+            reasons = (reply.get("finish_reasons")
+                       or [None] * len(toks))
+            preempts_total += int(reply.get("preempt_resumes", 0) or 0)
+            for i in range(len(chunk)):
+                out_f.write((json.dumps(
+                    {"row": cursor + i,
+                     "tokens": toks[i],
+                     "finish_reason": reasons[i]}) + "\n").encode())
+            # rows reach disk BEFORE the cursor commits: a crash
+            # between the two re-runs the chunk (truncated on resume),
+            # never skips or duplicates it
+            out_f.flush()
+            os.fsync(out_f.fileno())
+            cursor += len(chunk)
+            journal.write({"input": os.path.abspath(args.input),
+                           "input_sha": input_sha,
+                           "output": os.path.abspath(args.output),
+                           "cursor": cursor,
+                           "total": len(rows),
+                           "sheds": sheds_total,
+                           "preempt_resumes": preempts_total})
+            if args.progress:
+                print(json.dumps({"cursor": cursor,
+                                  "total": len(rows),
+                                  "sheds": sheds_total,
+                                  "preempt_resumes": preempts_total}),
+                      flush=True)
+    finally:
+        out_f.close()
+    print(json.dumps({"batch_done": True,
+                      "rows": len(rows),
+                      "resumed_at": resumed_at,
+                      "output": os.path.abspath(args.output),
+                      "journal": journal_path,
+                      "sheds": sheds_total,
+                      "preempt_resumes": preempts_total,
+                      "seconds": round(_time.perf_counter() - start,
+                                       3)}), flush=True)
+    return 0
+
+
 def cmd_pipeline(args) -> int:
     """`pipeline`: the crash-safe train→serve deployment controller —
     watch --checkpoint-dir for newly COMMITTED steps, gate each on a
@@ -792,6 +979,11 @@ def cmd_pipeline(args) -> int:
         return 2
     if args.spawn_fleet and not args.model:
         print("--spawn-fleet needs -m MODEL for the replicas",
+              file=sys.stderr)
+        return 2
+    if args.eval_via_fleet and not args.fleet_url:
+        print("--eval-via-fleet scores the LIVE fleet over HTTP and "
+              "needs --fleet-url (a router endpoint, not --spawn-fleet)",
               file=sys.stderr)
         return 2
     probe = None
@@ -826,6 +1018,7 @@ def cmd_pipeline(args) -> int:
             fleet=fleet,
             fleet_url=args.fleet_url,
             eval_data=args.eval_data,
+            eval_via_fleet=args.eval_via_fleet,
             label_columns=args.label_columns,
             metric=args.metric,
             eval_threshold=args.eval_threshold,
@@ -1072,6 +1265,12 @@ def build_parser() -> argparse.ArgumentParser:
                          help="bound the /predict coalescing queue; "
                               "past it requests shed with 503 + "
                               "Retry-After")
+    p_serve.add_argument("--batch-share", type=float, default=0.5,
+                         help="weighted-fair fraction of decode slots "
+                              "the batch SLO tier may hold while "
+                              "interactive requests wait — interactive "
+                              "preempts batch slots past it, losslessly "
+                              "(docs/SERVING.md \"Priority tiers\")")
     p_serve.add_argument("--smoke", action="store_true",
                          help="start, print the address, shut down")
     telemetry_flags(p_serve)
@@ -1101,6 +1300,12 @@ def build_parser() -> argparse.ArgumentParser:
     p_fleet.add_argument("--shed-high-water", type=int, default=None,
                          help="shed (503 + Retry-After) when this many "
                               "requests are in flight fleet-wide")
+    p_fleet.add_argument("--batch-high-water", type=int, default=None,
+                         help="shed BATCH-tier requests once this many "
+                              "are in flight fleet-wide (default: half "
+                              "of --shed-high-water) so bulk work sheds "
+                              "before the interactive lane feels "
+                              "pressure (docs/FLEET.md)")
     p_fleet.add_argument("--request-timeout", type=float, default=60.0,
                          help="per-hop /predict socket timeout ceiling; "
                               "requests carrying X-Deadline-Ms derive "
@@ -1185,6 +1390,46 @@ def build_parser() -> argparse.ArgumentParser:
                         help="single-line machine-readable output")
     p_eval.set_defaults(fn=cmd_eval)
 
+    p_batch = sub.add_parser(
+        "batch",
+        help="bulk generation through a router on the batch SLO tier "
+             "with crash-safe resumable progress (docs/SERVING.md "
+             "\"Priority tiers\")")
+    p_batch.add_argument("--url", required=True,
+                         help="router (or single replica) base URL")
+    p_batch.add_argument("--input", "-i", required=True,
+                         help="JSONL prompts: each line a token list "
+                              "or {\"prompt\": [...], "
+                              "\"max_tokens\": N}")
+    p_batch.add_argument("--output", "-o", required=True,
+                         help="JSONL results, one line per input row "
+                              "({row, tokens, finish_reason}); "
+                              "appended to on resume")
+    p_batch.add_argument("--journal", default=None, metavar="PATH",
+                         help="progress cursor journal (default: "
+                              "OUTPUT.journal); delete it and the "
+                              "output to restart from row 0")
+    p_batch.add_argument("--max-tokens", type=int, default=16,
+                         help="decode budget for rows that do not "
+                              "carry their own")
+    p_batch.add_argument("--batch-size", type=int, default=8,
+                         help="rows per /generate request (admitted "
+                              "as one group)")
+    p_batch.add_argument("--eos-id", type=int, default=None,
+                         help="stop rows early at this token id")
+    p_batch.add_argument("--timeout", type=float, default=300.0,
+                         help="per-request socket timeout — batch "
+                              "work queues behind interactive "
+                              "admission and may be preempted "
+                              "mid-stream, so keep it generous")
+    p_batch.add_argument("--max-shed-retries", type=int, default=120,
+                         help="per-chunk 503 sheds to wait out before "
+                              "giving up (each honors the tier-aware "
+                              "Retry-After, capped at 5s a beat)")
+    p_batch.add_argument("--progress", action="store_true",
+                         help="print a JSON progress line per chunk")
+    p_batch.set_defaults(fn=cmd_batch)
+
     p_pipe = sub.add_parser(
         "pipeline",
         help="crash-safe train->serve deployment controller: watch -> "
@@ -1219,6 +1464,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="held-out CSV for the promotion gate "
                              "(omitted = gate disabled: every committed "
                              "step is canaried)")
+    p_pipe.add_argument("--eval-via-fleet", action="store_true",
+                        help="refresh the champion's regression "
+                             "baseline by scoring --eval-data against "
+                             "the LIVE fleet on the batch SLO tier "
+                             "before each gate (needs --fleet-url; "
+                             "docs/PIPELINE.md)")
     p_pipe.add_argument("--label-columns", type=int, default=1)
     p_pipe.add_argument("--metric", default="f1",
                         choices=("f1", "accuracy", "precision",

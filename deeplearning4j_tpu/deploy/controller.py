@@ -106,6 +106,7 @@ class DeploymentController:
     def __init__(self, checkpoint_dir: str, *,
                  fleet=None, fleet_url: Optional[str] = None,
                  eval_data: Optional[str] = None,
+                 eval_via_fleet: bool = False,
                  label_columns: int = 1,
                  metric: str = "f1",
                  eval_threshold: float = 0.0,
@@ -120,10 +121,20 @@ class DeploymentController:
             raise ValueError(
                 "DeploymentController needs exactly one of fleet= "
                 "(in-process) or fleet_url= (router endpoint)")
+        if eval_via_fleet and fleet_url is None:
+            raise ValueError(
+                "eval_via_fleet scores the LIVE fleet over HTTP and "
+                "needs fleet_url= (a router endpoint)")
         self.checkpoint_dir = checkpoint_dir
         self.fleet = fleet
         self.fleet_url = fleet_url.rstrip("/") if fleet_url else None
         self.eval_data = eval_data
+        #: refresh the champion's regression baseline from the live
+        #: fleet (batch SLO tier — bulk scoring never competes with
+        #: interactive admission) instead of trusting the journaled
+        #: score: a drifted holdout or a champion reloaded behind the
+        #: controller's back would otherwise skew the gate
+        self.eval_via_fleet = bool(eval_via_fleet)
         self.label_columns = int(label_columns)
         self.metric = metric
         self.eval_threshold = float(eval_threshold)
@@ -355,6 +366,27 @@ class DeploymentController:
         score = metrics.get(self.metric)
         champ_metrics = (self.champion or {}).get("metrics") or {}
         champ_score = champ_metrics.get(self.metric)
+        if self.eval_via_fleet and self.champion is not None:
+            # regression baseline from the LIVE fleet, scored on the
+            # batch tier (docs/SERVING.md "Priority tiers") so the
+            # gate's bulk traffic sheds first and never preempts a
+            # user; an unreachable/shedding fleet falls back to the
+            # journaled champion score — an eval that could not run
+            # must not change the verdict's inputs silently
+            try:
+                from deeplearning4j_tpu.eval.holdout import \
+                    evaluate_via_fleet
+
+                live = evaluate_via_fleet(
+                    self.fleet_url, self.eval_data,
+                    label_columns=self.label_columns,
+                    timeout=self.request_timeout)
+                if live.get(self.metric) is not None:
+                    champ_score = live[self.metric]
+            except Exception as e:
+                log.warning(
+                    "live champion baseline unavailable (%s); using "
+                    "journaled score %s", e, champ_score)
         if score is None:
             verdict = f"metric {self.metric!r} missing from eval output"
         elif score < self.eval_threshold:
@@ -591,6 +623,7 @@ class DeploymentController:
             "quarantined": dict(self.quarantined),
             "incarnation": self.incarnation,
             "eval_threshold": self.eval_threshold,
+            "eval_via_fleet": self.eval_via_fleet,
             "regression_margin": self.regression_margin,
             "metric": self.metric,
             "poll_interval": self.poll_interval,

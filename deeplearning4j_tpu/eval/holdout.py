@@ -12,6 +12,16 @@ the metrics dict both consumers speak:
   it against its absolute threshold and the current champion's score
   before offering a candidate to the fleet (docs/PIPELINE.md).
 
+`evaluate_via_fleet(url, data)` is the live twin: it scores whatever a
+serving endpoint (fleet router or single replica) CURRENTLY serves by
+driving the held-out set through ``POST /predict`` — on the BATCH SLO
+tier (docs/SERVING.md "Priority tiers"), because bulk scoring is
+offline work that must never compete with interactive admission: it
+sheds first at the batch lane's lower high-water mark and honors the
+tier-aware ``Retry-After`` on a 503 before retrying. The deployment
+controller uses it to refresh the champion's baseline from the live
+fleet before the regression comparison (`eval_via_fleet=`).
+
 Held-out CSV shape matches the rest of the CLI: one row per example,
 features then the label column(s) — an integer class column when
 `label_columns == 1` (one-hot expanded against the MODEL's output
@@ -20,6 +30,7 @@ width, so a file missing the top class cannot shrink the label space).
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from typing import Optional, Tuple
@@ -28,7 +39,8 @@ import numpy as np
 
 from deeplearning4j_tpu.eval.evaluation import Evaluation
 
-__all__ = ["evaluate_checkpoint", "load_holdout_csv"]
+__all__ = ["evaluate_checkpoint", "evaluate_via_fleet",
+           "load_holdout_csv"]
 
 
 def load_holdout_csv(path: str, label_columns: int = 1,
@@ -73,6 +85,80 @@ def _load_net(model: str, step: Optional[int] = None):
 
     net, _ = load_checkpoint(model)
     return net, None
+
+
+def evaluate_via_fleet(url: str, data: str, *,
+                       label_columns: int = 1,
+                       n_classes: Optional[int] = None,
+                       batch_size: int = 64,
+                       timeout: float = 120.0,
+                       max_shed_retries: int = 8) -> dict:
+    """Score the held-out CSV against a LIVE serving endpoint (fleet
+    router or single replica) instead of loading weights locally —
+    the metrics describe whatever the endpoint currently serves.
+
+    Every request rides the BATCH SLO tier: the `X-Priority: batch`
+    header (and a matching `"priority"` body field, for endpoints
+    reached without the router) keeps bulk scoring out of the
+    interactive lane. A 503 shed is honored, not fatal: the reply's
+    `retry_after_ms` (derived from the batch lane's own backlog) is
+    waited out — capped at 5s a beat, `max_shed_retries` beats total —
+    before the chunk retries. Other HTTP errors raise RuntimeError
+    (the caller decides whether that is an infra failure)."""
+    import urllib.error
+    import urllib.request
+
+    from deeplearning4j_tpu.serving.errors import (PRIORITY_HEADER,
+                                                   TIER_BATCH)
+
+    x, y = load_holdout_csv(data, label_columns, n_classes)
+    url = url.rstrip("/")
+    start = time.perf_counter()
+    outs = []
+    sheds = 0
+    for lo in range(0, x.shape[0], batch_size):
+        body = json.dumps({
+            "inputs": x[lo:lo + batch_size].tolist(),
+            "priority": TIER_BATCH}).encode()
+        while True:
+            req = urllib.request.Request(
+                url + "/predict", data=body,
+                headers={"Content-Type": "application/json",
+                         PRIORITY_HEADER: TIER_BATCH})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    payload = json.loads(r.read())
+                break
+            except urllib.error.HTTPError as e:
+                raw = e.read()
+                if e.code == 503 and sheds < max_shed_retries:
+                    sheds += 1
+                    try:
+                        retry_ms = json.loads(raw).get(
+                            "retry_after_ms", 1000)
+                    except ValueError:
+                        retry_ms = 1000
+                    time.sleep(min(5.0, max(0.05, retry_ms / 1000.0)))
+                    continue
+                raise RuntimeError(
+                    f"fleet eval: /predict answered {e.code}: "
+                    f"{raw.decode(errors='replace')[:200]}") from e
+        outs.append(np.asarray(payload["outputs"], dtype=np.float32))
+    ev = Evaluation()
+    ev.eval(y, np.concatenate(outs, axis=0))
+    return {
+        "f1": ev.f1(),
+        "accuracy": ev.accuracy(),
+        "precision": ev.precision(),
+        "recall": ev.recall(),
+        "n": int(x.shape[0]),
+        "path": url,
+        "step": None,
+        "via": "fleet",
+        "tier": TIER_BATCH,
+        "shed_retries": sheds,
+        "eval_seconds": round(time.perf_counter() - start, 6),
+    }
 
 
 def evaluate_checkpoint(model: str, data: str, *,

@@ -33,6 +33,12 @@ Contract:
   requests already waiting raises `OverloadedError` (503 + Retry-After
   on the HTTP surface, docs/FLEET.md) instead of queueing unboundedly —
   shedding at the door beats timing out after the queue.
+- **SLO tiers** (`submit(x, tier=)`, docs/SERVING.md "Priority
+  tiers"): the coalescing queue is shared (one engine pass serves every
+  tier), so tiers bite at ADMISSION — batch sheds first, at the lower
+  `batch_max_queue` water mark (default half of `max_queue`) — and the
+  shed reply carries the shed tier plus a Retry-After derived from the
+  queue depth it actually saw, not a global constant.
 - **Deadlines** (docs/SERVING.md "Deadlines"): `submit(x, deadline=)`
   raises `DeadlineExceededError` for an already-expired budget, and the
   worker re-checks at DISPATCH — a request whose budget died while it
@@ -59,9 +65,12 @@ from typing import Callable, NamedTuple, Optional
 import numpy as np
 
 from deeplearning4j_tpu import telemetry
-from deeplearning4j_tpu.serving.errors import (Deadline,
+from deeplearning4j_tpu.serving.errors import (TIER_BATCH,
+                                               TIER_INTERACTIVE, TIERS,
+                                               Deadline,
                                                DeadlineExceededError,
-                                               OverloadedError)
+                                               OverloadedError,
+                                               backlog_retry_ms)
 
 __all__ = ["MicroBatcher"]
 
@@ -73,6 +82,7 @@ class _Request(NamedTuple):
     x: np.ndarray
     future: Future
     deadline: Optional[Deadline] = None
+    tier: str = TIER_INTERACTIVE
 
 
 def _resolve(fut: Future, value=None, exc: Optional[BaseException] = None
@@ -93,6 +103,7 @@ class MicroBatcher:
     def __init__(self, run_batch: Callable[[np.ndarray], np.ndarray], *,
                  max_batch_size: int = 64, max_delay_ms: float = 2.0,
                  max_queue: Optional[int] = None,
+                 batch_max_queue: Optional[int] = None,
                  name: str = "micro-batcher"):
         if max_batch_size < 1:
             raise ValueError(
@@ -102,10 +113,21 @@ class MicroBatcher:
                 f"max_delay_ms must be >= 0, got {max_delay_ms}")
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if batch_max_queue is not None and batch_max_queue < 1:
+            raise ValueError(
+                f"batch_max_queue must be >= 1, got {batch_max_queue}")
         self._run = run_batch
         self.max_batch_size = int(max_batch_size)
         self.max_delay_s = max_delay_ms / 1000.0
         self.max_queue = None if max_queue is None else int(max_queue)
+        # the bulk lane's lower water mark on the SHARED queue: batch
+        # sheds first, keeping headroom for interactive arrivals
+        if batch_max_queue is not None:
+            self.batch_max_queue: Optional[int] = int(batch_max_queue)
+        elif self.max_queue is not None:
+            self.batch_max_queue = max(1, self.max_queue // 2)
+        else:
+            self.batch_max_queue = None
         self._q: "queue.Queue" = queue.Queue()
         self._closed = False
         self._lock = threading.Lock()
@@ -138,6 +160,20 @@ class MicroBatcher:
             "dl4j_batcher_cancelled",
             "abandoned requests (client-cancelled futures) dropped at "
             "dispatch").labels(**lab)
+        _tier_req = reg.counter(
+            "dl4j_tier_requests",
+            "generate requests submitted per SLO tier (interactive "
+            "goes ahead at admission; batch rides the weighted-fair "
+            "bulk lane)")
+        tscope = {"scope": f"batcher:{self.label}"}
+        self._m_tier_requests = {
+            t: _tier_req.labels(tier=t, **tscope) for t in TIERS}
+        _tier_shed = reg.counter(
+            "dl4j_tier_shed",
+            "generate requests shed at submit per SLO tier (batch "
+            "sheds first, at its own lower batch_max_waiting bound)")
+        self._m_tier_shed = {
+            t: _tier_shed.labels(tier=t, **tscope) for t in TIERS}
         self._m_queue = reg.gauge(
             "dl4j_batcher_queue_depth",
             "requests waiting in the coalescing queue").labels(**lab)
@@ -172,12 +208,19 @@ class MicroBatcher:
         return int(self._m_rows.value)
 
     # ----------------------------------------------------------- submit
-    def submit(self, x, deadline: Optional[Deadline] = None) -> Future:
+    def submit(self, x, deadline: Optional[Deadline] = None,
+               tier: str = TIER_INTERACTIVE) -> Future:
         """Enqueue one request; the future resolves to the engine output
         rows for exactly these input rows. An already-expired `deadline`
         raises DeadlineExceededError here (504 on the HTTP surface) —
         and is re-checked at dispatch, so a budget that dies in the
-        queue never reaches the engine either."""
+        queue never reaches the engine either. `tier="batch"` sheds at
+        the lower `batch_max_queue` water mark (bulk traffic backs off
+        before it can crowd out interactive admission); coalescing
+        itself is tier-blind — one engine pass serves every tier."""
+        if tier not in TIERS:
+            raise ValueError(
+                f"unknown tier {tier!r} (expected one of {TIERS})")
         if deadline is not None and deadline.expired:
             self._m_deadline.inc()
             deadline.check("batcher admission")  # raises
@@ -195,20 +238,32 @@ class MicroBatcher:
             if self._closed:
                 fut.set_exception(RuntimeError("batcher is closed"))
                 return fut
-            if (self.max_queue is not None
-                    and self._q.qsize() >= self.max_queue):
+            bound = (self.batch_max_queue if tier == TIER_BATCH
+                     else self.max_queue)
+            depth = self._q.qsize()
+            if bound is not None and depth >= bound:
                 # shed at the door: raising (not poisoning the future)
                 # lets callers that route/queue-manage see the signal
-                # before any work is enqueued
+                # before any work is enqueued. The backoff is derived
+                # from the depth this tier actually hit — each queued
+                # request costs roughly one slice of a coalescing
+                # window to drain — and the reply names the shed tier.
                 self._m_shed.inc()
+                self._m_tier_shed[tier].inc()
                 raise OverloadedError(
-                    f"batcher queue full ({self.max_queue} waiting)",
-                    retry_after_ms=max(50, int(self.max_delay_s * 2000)))
+                    f"batcher queue full for tier {tier!r} "
+                    f"({depth} waiting, bound {bound})",
+                    retry_after_ms=backlog_retry_ms(
+                        depth + 1,
+                        max(1.0, self.max_delay_s * 2000.0
+                            / self.max_batch_size)),
+                    tier=tier)
             self._m_submitted.inc()
+            self._m_tier_requests[tier].inc()
             # enqueue under the lock: close() also takes it before
             # putting the sentinel, so no request can land AFTER _CLOSE
             # and strand its future in a dead queue
-            self._q.put(_Request(arr, fut, deadline))
+            self._q.put(_Request(arr, fut, deadline, tier))
         return fut
 
     # ----------------------------------------------------------- worker
@@ -338,5 +393,12 @@ class MicroBatcher:
             "queue_depth": self._q.qsize(),
             "max_batch_size": self.max_batch_size,
             "max_queue": self.max_queue,
+            "batch_max_queue": self.batch_max_queue,
             "max_delay_ms": self.max_delay_s * 1000.0,
+            "tiers": {
+                "requests": {t: int(self._m_tier_requests[t].value)
+                             for t in TIERS},
+                "shed": {t: int(self._m_tier_shed[t].value)
+                         for t in TIERS},
+            },
         }

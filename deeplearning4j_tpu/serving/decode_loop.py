@@ -111,6 +111,23 @@ counts both and tests/bench pin <= 2). Telemetry:
 dl4j_spec_{proposed,accepted,rounds} counters and an acceptance-rate
 gauge in snapshot()/stats (docs/SERVING.md "Speculative decoding").
 
+**SLO tiers + preemption** (`tier="interactive"|"batch"` on submit):
+every stream carries a priority tier. Interactive (the default) is the
+latency tier; batch is the bulk lane riding the same slots and pages.
+Admission is tier-priority (every interactive arrival goes ahead of
+every batch one, FIFO within a tier), batch holds at most a
+weighted-fair share of the slots while interactive work wants the
+machine (`batch_share`, default half) and soaks ALL idle capacity when
+none does, batch sheds at its own lower `batch_max_waiting` bound with
+a Retry-After derived from the batch backlog, and a blocked interactive
+admission PREEMPTS batch slots: the victim (fewest tokens emitted — the
+cheapest resume) retires with finish_reason `"preempted"`, its pages
+return to the pool, and its full prompt pages seed the prefix cache so
+the router-side durable-stream resume replays the prefix nearly for
+free. Preemption is pure host bookkeeping — slot retirement, exactly
+the cancel/deadline path — so `decode_step_programs()` stays pinned
+(docs/SERVING.md "Priority tiers").
+
 Telemetry: dl4j_kv_pages_total / dl4j_kv_pages_in_use /
 dl4j_kv_pages_shared / dl4j_kv_pages_cached /
 dl4j_decode_active_slots gauges, dl4j_decode_requests /
@@ -135,9 +152,12 @@ import numpy as np
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.attention.paged_pallas import resolve_decode_kernel
 from deeplearning4j_tpu.models.transformer import TransformerConfig
-from deeplearning4j_tpu.serving.errors import (Deadline,
+from deeplearning4j_tpu.serving.errors import (TIER_BATCH,
+                                               TIER_INTERACTIVE, TIERS,
+                                               Deadline,
                                                DeadlineExceededError,
-                                               OverloadedError)
+                                               OverloadedError,
+                                               backlog_retry_ms)
 from deeplearning4j_tpu.serving.paged_kv import (copy_page,
                                                  decode_read_bytes,
                                                  init_paged_pool,
@@ -159,6 +179,12 @@ __all__ = ["GenerationStream", "DecodeLoop"]
 _DONE = object()
 _loop_seq = itertools.count()
 
+#: per-queued-item service estimate feeding the backlog-derived
+#: Retry-After on a tier shed: interactive items are short user turns,
+#: batch items long bulk rows — a deep batch backlog should tell its
+#: client to come back much later than an interactive blip would
+_TIER_ITEM_MS = {TIER_INTERACTIVE: 50.0, TIER_BATCH: 250.0}
+
 
 class GenerationStream:
     """One in-flight generate request: a token queue the scheduler
@@ -170,7 +196,10 @@ class GenerationStream:
     the stream finishes and returns the full generated list;
     `full_sequence()` is prompt + generated — the backward-compatible
     `/generate` response row. `finish_reason` is "eos", "max_tokens",
-    "cancelled", "deadline_exceeded" or "error" once done."""
+    "cancelled", "deadline_exceeded", "preempted" (a batch slot evicted
+    for an interactive arrival — error stays None so already-emitted
+    tokens relay, and the router re-admits the row as a durable-stream
+    resume) or "error" once done."""
 
     def __init__(self, prompt: Sequence[int], max_tokens: int,
                  eos_id: Optional[int],
@@ -188,6 +217,11 @@ class GenerationStream:
         #: latency A/Bs and for keeping draft-model compute off a
         #: request entirely (set by submit_many)
         self.speculation = True
+        #: SLO tier (set by submit_many): "interactive" requests go
+        #: ahead of "batch" ones at admission and may preempt their
+        #: slots; "batch" rides the weighted-fair bulk lane
+        #: (docs/SERVING.md "Priority tiers")
+        self.tier = TIER_INTERACTIVE
         #: absolute index of the FIRST token this stream will emit —
         #: non-zero when the request is a failover continuation whose
         #: already-delivered tokens ride in as prompt context. The
@@ -314,6 +348,8 @@ class DecodeLoop:
                  speculation: int = 0, drafter: str = "ngram",
                  draft_params=None, draft_cfg=None,
                  draft_window: int = 32, ngram: int = 3,
+                 batch_share: float = 0.5,
+                 batch_max_waiting: Optional[int] = None,
                  start: bool = True, name: Optional[str] = None):
         import jax
         import jax.numpy as jnp
@@ -333,6 +369,13 @@ class DecodeLoop:
         if max_waiting is not None and max_waiting < 0:
             raise ValueError(
                 f"max_waiting must be >= 0, got {max_waiting}")
+        if not 0.0 < batch_share <= 1.0:
+            raise ValueError(
+                f"batch_share must be in (0, 1], got {batch_share}")
+        if batch_max_waiting is not None and batch_max_waiting < 0:
+            raise ValueError(
+                f"batch_max_waiting must be >= 0, "
+                f"got {batch_max_waiting}")
         self.cfg = cfg
         self.params = params
         self.slots = int(slots)
@@ -355,6 +398,24 @@ class DecodeLoop:
         #: while this many requests already wait sheds with
         #: OverloadedError (None = queue unboundedly, legacy behavior)
         self.max_waiting = None if max_waiting is None else int(max_waiting)
+        #: weighted-fair share: while interactive work wants the
+        #: machine, batch holds at most this many slots; with no
+        #: interactive demand batch soaks everything (SLO tiers)
+        self.batch_share = float(batch_share)
+        self._batch_slot_cap = max(1, int(round(self.slots
+                                                * self.batch_share)))
+        #: the bulk lane's OWN (lower) admission-queue bound — batch
+        #: sheds first; defaults to half the interactive bound
+        if batch_max_waiting is not None:
+            self.batch_max_waiting: Optional[int] = int(batch_max_waiting)
+        elif self.max_waiting is not None:
+            self.batch_max_waiting = self.max_waiting // 2
+        else:
+            self.batch_max_waiting = None
+        #: live per-tier admission-queue depth (kept exact under the
+        #: lock so the backlog gauge/shed math never iterates the deque
+        #: racily)
+        self._tier_waiting = {t: 0 for t in TIERS}
         self._buckets = prompt_buckets(cfg, self.page_size)
 
         # device state ------------------------------------------------
@@ -515,6 +576,25 @@ class DecodeLoop:
             "dl4j_kv_prefix_evictions",
             "unreferenced cached prefix pages evicted (LRU) to satisfy "
             "an allocation under page pressure").labels(**lab)
+        _tier_req = reg.counter(
+            "dl4j_tier_requests",
+            "generate requests submitted per SLO tier (interactive "
+            "goes ahead at admission; batch rides the weighted-fair "
+            "bulk lane)")
+        tscope = {"scope": f"loop:{self.label}"}
+        self._m_tier_requests = {
+            t: _tier_req.labels(tier=t, **tscope) for t in TIERS}
+        _tier_shed = reg.counter(
+            "dl4j_tier_shed",
+            "generate requests shed at submit per SLO tier (batch "
+            "sheds first, at its own lower batch_max_waiting bound)")
+        self._m_tier_shed = {
+            t: _tier_shed.labels(tier=t, **tscope) for t in TIERS}
+        self._m_preempt = reg.counter(
+            "dl4j_tier_preemptions",
+            "batch decode slots preempted for a blocked interactive "
+            "admission (lossless: the row resumes via the router's "
+            "durable-stream record)").labels(tier=TIER_BATCH, **tscope)
         self._m_spec_proposed = reg.counter(
             "dl4j_spec_proposed",
             "draft tokens proposed to speculative verify rounds"
@@ -571,6 +651,15 @@ class DecodeLoop:
             "slots holding an in-flight request").labels(
                 **lab).set_function(
             lambda: (lambda o: o.occupied_slots if o else 0)(ref()))
+        _backlog = reg.gauge(
+            "dl4j_tier_backlog",
+            "generate requests queued for admission per SLO tier (the "
+            "batch figure is the signal the autoscaler and the "
+            "backlog-derived Retry-After key on)")
+        for t in TIERS:
+            _backlog.labels(tier=t, **tscope).set_function(
+                (lambda _t: lambda: (lambda o: o._tier_waiting[_t]
+                                     if o else 0)(ref()))(t))
         reg.gauge(
             "dl4j_spec_acceptance_rate",
             "accepted / proposed draft tokens over the loop's lifetime "
@@ -623,7 +712,8 @@ class DecodeLoop:
                eos_id: Optional[int] = None,
                deadline: Optional[Deadline] = None,
                prefix_cache: bool = True,
-               speculation: bool = True) -> GenerationStream:
+               speculation: bool = True,
+               tier: str = TIER_INTERACTIVE) -> GenerationStream:
         """Queue one prompt (1-D int sequence). The stream's first token
         arrives after admission + prefill; termination on EOS (when
         given), `max_tokens`, or the model window. `prefix_cache=False`
@@ -631,18 +721,23 @@ class DecodeLoop:
         reuses cached pages nor seeds new ones (benchmark cold runs;
         secret-bearing prompts). `speculation=False` opts it out of
         speculative drafting (plain one-token rounds; output is
-        bit-identical either way)."""
+        bit-identical either way). `tier="batch"` rides the bulk lane:
+        admitted behind every interactive arrival, capped at the
+        weighted-fair slot share under interactive demand, shed first,
+        and preemptible (finish_reason "preempted")."""
         return self.submit_many([prompt], max_tokens, eos_id,
                                 deadline=deadline,
                                 prefix_cache=prefix_cache,
-                                speculation=speculation)[0]
+                                speculation=speculation,
+                                tier=tier)[0]
 
     def submit_many(self, prompts, max_tokens,
                     eos_id: Optional[int] = None,
                     deadline: Optional[Deadline] = None,
                     prefix_cache: bool = True,
                     token_index_base=0,
-                    speculation: bool = True
+                    speculation: bool = True,
+                    tier: str = TIER_INTERACTIVE
                     ) -> List[GenerationStream]:
         """Admit several rows as ONE unit: all rows enqueue or none do.
         A shed that fired between a multi-row request's submits would
@@ -659,7 +754,17 @@ class DecodeLoop:
         with its own remaining budget and absolute-index offset. Both
         per-row lists are length- and value-checked UP FRONT with a
         named error — a short or negative list must fail before any
-        row-mate is enqueued, not deep in slot admission."""
+        row-mate is enqueued, not deep in slot admission.
+
+        `tier` ("interactive" default, "batch") applies to the whole
+        group. Batch sheds at its own `batch_max_waiting` bound — the
+        bulk lane fills and sheds FIRST — and both tiers' shed replies
+        carry the shed tier plus a Retry-After derived from that
+        tier's backlog, so a bulk client backs off proportionally to
+        the lane it actually waits in."""
+        if tier not in TIERS:
+            raise ValueError(
+                f"unknown tier {tier!r} (expected one of {TIERS})")
         if deadline is not None and deadline.expired:
             self._m_deadline.inc()
             deadline.check("decode admission")  # raises
@@ -681,13 +786,18 @@ class DecodeLoop:
             stream.prefix_cache = bool(prefix_cache)
             stream.speculation = bool(speculation)
             stream.token_index_base = base
+            stream.tier = tier
         with self._cond:
             if self._closed:
                 raise RuntimeError("decode loop is closed")
-            if self.max_waiting is not None:
+            bound = (self.batch_max_waiting if tier == TIER_BATCH
+                     else self.max_waiting)
+            if bound is not None:
                 # free-page starvation / slot saturation sheds at the
-                # door once the admission queue is at its bound — a
-                # group that could start right now is never rejected
+                # door once the TIER's admission queue is at its bound
+                # — a group that could start right now is never
+                # rejected, and a deep bulk backlog never sheds the
+                # interactive lane (those arrivals preempt instead)
                 need = sum(pages_for_tokens(p.size + 1, self.page_size)
                            for p in prompts)
                 free_slots = sum(1 for s in self._slot_state
@@ -695,17 +805,23 @@ class DecodeLoop:
                 can_now = (not self._waiting
                            and self._avail_pages() >= need
                            and free_slots >= len(prompts))
-                if (not can_now and len(self._waiting) + len(prompts)
-                        > self.max_waiting):
+                tier_q = self._tier_waiting[tier]
+                if not can_now and tier_q + len(prompts) > bound:
                     self._m_shed.inc()
+                    self._m_tier_shed[tier].inc()
                     raise OverloadedError(
-                        f"decode admission queue full "
-                        f"({len(self._waiting)} waiting, "
+                        f"decode admission queue full for tier "
+                        f"{tier!r} ({tier_q} waiting, "
                         f"{len(self._free)}/{self.n_pages} pages free)",
-                        retry_after_ms=250)
+                        retry_after_ms=backlog_retry_ms(
+                            tier_q + len(prompts),
+                            _TIER_ITEM_MS[tier]),
+                        tier=tier)
             for stream in streams:
                 self._m_requests.inc()
+                self._m_tier_requests[tier].inc()
                 self._waiting.append(stream)
+                self._tier_waiting[tier] += 1
             self._cond.notify_all()
         return streams
 
@@ -848,6 +964,23 @@ class DecodeLoop:
                 "peak_pages_in_use": self._peak_pages,
                 "pool_bytes": self.kv_pool_bytes(),
                 "max_waiting": self.max_waiting,
+                "tiers": {
+                    "batch_share": self.batch_share,
+                    "batch_slot_cap": self._batch_slot_cap,
+                    "batch_max_waiting": self.batch_max_waiting,
+                    "preemptions": int(self._m_preempt.value),
+                    "waiting": dict(self._tier_waiting),
+                    "occupied": {
+                        t: sum(1 for s in self._slot_state
+                               if s is not None and s.stream.tier == t)
+                        for t in TIERS},
+                    "requests": {
+                        t: int(self._m_tier_requests[t].value)
+                        for t in TIERS},
+                    "shed": {
+                        t: int(self._m_tier_shed[t].value)
+                        for t in TIERS},
+                },
                 "requests": int(self._m_requests.value),
                 "tokens_streamed": int(self._m_tokens.value),
                 "shed": int(self._m_shed.value),
@@ -941,7 +1074,9 @@ class DecodeLoop:
                     slot.stream._finish("error", exc)
                     self._slot_state[i] = None
             while self._waiting:
-                self._waiting.popleft()._finish("error", exc)
+                stream = self._waiting.popleft()
+                self._tier_waiting[stream.tier] -= 1
+                stream._finish("error", exc)
 
     def tick(self) -> bool:
         """One scheduler pass: admit what fits, grant boundary pages,
@@ -950,6 +1085,11 @@ class DecodeLoop:
         tests (and `start=False` callers) can drive the loop
         deterministically."""
         self._reap()
+        # chaos point: a "delay" rule paces every scheduler pass (the
+        # SLO drills use it to pin slot occupancy open long enough for
+        # preemption to observably fire); an "error" drills the
+        # fail-loudly path in _run
+        chaos.hit("decode.step")
         self._admit()
         ran = self._dispatch()
         if not ran:
@@ -1010,6 +1150,35 @@ class DecodeLoop:
                                      .elapsed_ms()))
 
     # ---- admission
+    def _preempt_one(self, used: set) -> bool:
+        """Evict ONE batch-held slot so a blocked interactive admission
+        can proceed. The victim — the batch slot with the FEWEST tokens
+        emitted, the cheapest to resume — retires with finish_reason
+        "preempted" and error None: every token it already emitted was
+        already streamed (and dedupable by absolute `token_index`), its
+        pages return to the pool, and its full prompt pages seed the
+        prefix cache so the router-side durable-stream resume replays
+        the prefix nearly for free. Lossless by construction — the
+        router re-admits `prompt + delivered` with the remaining budget
+        exactly as a replica-failure resume would (docs/SERVING.md
+        "Priority tiers"). Pure host bookkeeping: the retirement path
+        is the cancel/deadline one, so `decode_step_programs()` never
+        moves. Returns True when a victim was retired. Caller holds the
+        lock."""
+        victim = None
+        for i, slot in enumerate(self._slot_state):
+            if slot is None or slot.stream.tier != TIER_BATCH:
+                continue
+            if (victim is None or slot.emitted
+                    < self._slot_state[victim].emitted):
+                victim = i
+        if victim is None:
+            return False
+        self._m_preempt.inc()
+        self._retire(victim, self._slot_state[victim], "preempted")
+        used.discard(victim)
+        return True
+
     def _admit(self) -> None:
         import jax.numpy as jnp
 
@@ -1019,18 +1188,31 @@ class DecodeLoop:
         with self._cond:
             used = {i for i, s in enumerate(self._slot_state)
                     if s is not None}
+            batch_held = sum(1 for s in self._slot_state
+                             if s is not None
+                             and s.stream.tier == TIER_BATCH)
+            inter_held = len(used) - batch_held
             while self._waiting:
-                stream = self._waiting[0]
+                # tier-priority scan: every interactive arrival goes
+                # ahead of every batch one (FIFO within a tier) — a
+                # head-of-line bulk prompt must never make the user who
+                # is watching wait
+                stream = next((s for s in self._waiting
+                               if s.tier == TIER_INTERACTIVE),
+                              self._waiting[0])
+                interactive = stream.tier == TIER_INTERACTIVE
                 # queue-expired or cancelled work is shed here, BEFORE
                 # any prefill compute (the dispatch counters pin it)
                 if stream.cancelled:
-                    self._waiting.popleft()
+                    self._waiting.remove(stream)
+                    self._tier_waiting[stream.tier] -= 1
                     self._m_cancelled.inc()
                     stream._finish("cancelled")
                     continue
                 if (stream.deadline is not None
                         and stream.deadline.expired):
-                    self._waiting.popleft()
+                    self._waiting.remove(stream)
+                    self._tier_waiting[stream.tier] -= 1
                     self._m_deadline.inc()
                     stream._finish(
                         "deadline_exceeded", DeadlineExceededError(
@@ -1039,9 +1221,23 @@ class DecodeLoop:
                             deadline_ms=stream.deadline.budget_ms,
                             elapsed_ms=stream.deadline.elapsed_ms()))
                     continue
+                if (not interactive
+                        and batch_held >= self._batch_slot_cap
+                        and inter_held > 0):
+                    # weighted-fair share: while interactive work is
+                    # live on the machine, batch holds at most its
+                    # share of the slots — it soaks ALL idle capacity
+                    # only when no user-facing work wants it
+                    self._m_waits.inc()
+                    break
                 plen = len(stream.prompt)
                 idx = next((i for i in range(self.slots)
                             if i not in used), None)
+                while (idx is None and interactive
+                       and self._preempt_one(used)):
+                    batch_held -= 1
+                    idx = next((i for i in range(self.slots)
+                                if i not in used), None)
                 if idx is None:
                     self._m_waits.inc()
                     break
@@ -1063,12 +1259,20 @@ class DecodeLoop:
                 # headroom) — the check that replaces the contiguous
                 # path's whole-max_len reservation
                 need = pages_for_tokens(plen + 1, ps) - len(matched)
+                while (self._avail_pages() < need and interactive
+                       and self._preempt_one(used)):
+                    batch_held -= 1
                 if self._avail_pages() < need:
                     for page in matched:
                         self._release_page(page)
                     self._m_waits.inc()
                     break
-                self._waiting.popleft()
+                self._waiting.remove(stream)
+                self._tier_waiting[stream.tier] -= 1
+                if interactive:
+                    inter_held += 1
+                else:
+                    batch_held += 1
                 used.add(idx)
                 alloc = pages_for_tokens(plen, ps) - len(matched)
                 pages = list(matched)
@@ -1501,12 +1705,15 @@ class DecodeLoop:
             self._stop[idx] = 0
             self._pending[idx] = 0
             if (self._prefix is not None and slot.stream.prefix_cache
-                    and reason in ("eos", "max_tokens")):
+                    and reason in ("eos", "max_tokens", "preempted")):
                 # seed the cache with the FULL prompt pages only —
                 # decode pages hold this request's continuation, and a
                 # partial prompt page would be rewritten by the next
                 # reader's cursor. Forked pages never seed (no_cache):
                 # their bytes diverged from the pure token sequence.
+                # "preempted" seeds too: the durable-stream resume
+                # re-sends this prompt as a prefix, and the cache is
+                # what makes that replay near-free.
                 n_full = len(slot.stream.prompt) // self.page_size
                 self._prefix.insert(slot.stream.prompt,
                                     slot.pages[:n_full],

@@ -206,6 +206,8 @@ class InferenceEngine:
                         drafter: str = "ngram",
                         draft_params=None, draft_cfg=None,
                         draft_window: int = 32,
+                        batch_share: float = 0.5,
+                        batch_max_waiting: Optional[int] = None,
                         **kw) -> "InferenceEngine":
         """Wrap a transformer LM: apply = full logits (B, T, vocab);
         `generate()` runs the per-request KV-cached compiled scan.
@@ -240,7 +242,9 @@ class InferenceEngine:
                                   drafter=drafter,
                                   draft_params=draft_params,
                                   draft_cfg=draft_cfg,
-                                  draft_window=draft_window)
+                                  draft_window=draft_window,
+                                  batch_share=batch_share,
+                                  batch_max_waiting=batch_max_waiting)
         return eng
 
     @classmethod
@@ -319,7 +323,9 @@ class InferenceEngine:
                           speculation: int = 0,
                           drafter: str = "ngram",
                           draft_params=None, draft_cfg=None,
-                          draft_window: int = 32):
+                          draft_window: int = 32,
+                          batch_share: float = 0.5,
+                          batch_max_waiting: Optional[int] = None):
         """Start the continuous-batching slot scheduler
         (serving/decode_loop.py) for this transformer engine: S slots
         over a paged KV pool riding ONE compiled decode step. `/generate`
@@ -328,7 +334,10 @@ class InferenceEngine:
         scales with written tokens. `kernel` picks the decode attention
         lane ("auto"|"pallas"|"gather", docs/SERVING.md);
         `speculation = k` turns on draft-and-verify with the chosen
-        `drafter` ("ngram"|"model")."""
+        `drafter` ("ngram"|"model"). `batch_share`/`batch_max_waiting`
+        tune the batch SLO tier's weighted-fair slot share and its
+        (lower) admission-queue bound (docs/SERVING.md "Priority
+        tiers")."""
         from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
 
         if self._tf_cfg is None:
@@ -347,7 +356,9 @@ class InferenceEngine:
                                       drafter=drafter,
                                       draft_params=draft_params,
                                       draft_cfg=draft_cfg,
-                                      draft_window=draft_window)
+                                      draft_window=draft_window,
+                                      batch_share=batch_share,
+                                      batch_max_waiting=batch_max_waiting)
         return self.decode_loop
 
     def generate_stream(self, prompt, max_tokens: int,

@@ -10,6 +10,18 @@ RuntimeError, and every HTTP front end maps it to the same wire shape —
 `{"error": "overloaded", "retry_after_ms": N}` — so clients and load
 balancers can back off without parsing prose (docs/FLEET.md).
 
+`TIER_INTERACTIVE` / `TIER_BATCH` are the two SLO tiers every serving
+request carries (an `X-Priority` header, or a `"priority"` body field
+where the body is parsed anyway; absent -> interactive). The tier rides
+the whole admission path — router select, fleet dispatch, micro-batcher
+queue, decode-loop slot/page accounting — so shedding and preemption
+can favor the user who is watching: batch sheds first (at a lower
+water mark), and an interactive arrival may preempt a batch decode
+slot, turning the batch row into a durable-stream resume record
+(docs/SERVING.md "Priority tiers"). A shed reply names the tier that
+was shed and derives `Retry-After` from THAT tier's backlog, so a bulk
+client backs off long while an interactive client retries soon.
+
 `Deadline` / `DeadlineExceededError` are the end-to-end time-budget
 twins: a client sends `deadline_ms` (an `X-Deadline-Ms` header, or a
 `deadline_ms` body field where the body is parsed anyway), every hop
@@ -30,20 +42,65 @@ from typing import Optional
 
 __all__ = ["OverloadedError", "overload_body",
            "Deadline", "DeadlineExceededError", "deadline_body",
-           "DEADLINE_HEADER", "replica_failed_body"]
+           "DEADLINE_HEADER", "replica_failed_body",
+           "TIER_INTERACTIVE", "TIER_BATCH", "TIERS",
+           "PRIORITY_HEADER", "parse_tier", "backlog_retry_ms"]
 
 #: the wire header carrying the REMAINING budget in milliseconds; each
 #: forwarding hop rewrites it smaller (never larger)
 DEADLINE_HEADER = "X-Deadline-Ms"
 
+#: the wire header carrying the request's SLO tier; the router forwards
+#: it so replicas never need to re-parse the body
+PRIORITY_HEADER = "X-Priority"
+
+#: the latency tier: a user is watching — sheds last, may preempt batch
+TIER_INTERACTIVE = "interactive"
+#: the throughput tier: bulk generation/eval — sheds first, preemptible
+TIER_BATCH = "batch"
+TIERS = (TIER_INTERACTIVE, TIER_BATCH)
+
+
+def parse_tier(headers=None, body=None) -> str:
+    """Parse a request's SLO tier: the `X-Priority` header wins, else a
+    `"priority"` body field; absent -> interactive (the safe default —
+    an untagged client is a user). Unknown values raise ValueError so a
+    typo'd `"bacth"` fails loudly instead of silently racing users."""
+    raw = headers.get(PRIORITY_HEADER) if headers is not None else None
+    if raw is None and isinstance(body, dict):
+        raw = body.get("priority")
+    if raw is None:
+        return TIER_INTERACTIVE
+    tier = str(raw).strip().lower()
+    if tier not in TIERS:
+        raise ValueError(
+            f"unknown priority tier {raw!r} (expected one of {TIERS})")
+    return tier
+
+
+def backlog_retry_ms(backlog: int, per_item_ms: float,
+                     floor_ms: int = 50, cap_ms: int = 30_000) -> int:
+    """Retry-After derived from the shed tier's OWN backlog: roughly
+    how long the queue ahead of a retry takes to drain (`backlog` items
+    at `per_item_ms` estimated service each), floored so a race with an
+    emptying queue still backs off a beat, capped so a deep bulk
+    backlog never tells a client "come back in an hour"."""
+    est = int(max(0, backlog) * max(0.0, per_item_ms))
+    return max(floor_ms, min(cap_ms, est if est > 0 else floor_ms))
+
 
 class OverloadedError(RuntimeError):
     """An admission queue is full (or a shed high-water mark is hit);
-    the caller should retry after `retry_after_ms`."""
+    the caller should retry after `retry_after_ms`. `tier` names which
+    SLO tier was shed (None on legacy untiered sites) so the 503 body
+    tells a bulk client "YOUR lane is full" even when interactive
+    admission is wide open."""
 
-    def __init__(self, message: str, retry_after_ms: int = 1000):
+    def __init__(self, message: str, retry_after_ms: int = 1000,
+                 tier: Optional[str] = None):
         super().__init__(message)
         self.retry_after_ms = max(1, int(retry_after_ms))
+        self.tier = tier
 
     @property
     def retry_after_s(self) -> int:
@@ -53,9 +110,12 @@ class OverloadedError(RuntimeError):
 
 def overload_body(exc: OverloadedError) -> dict:
     """The JSON body every 503-overloaded reply carries."""
-    return {"error": "overloaded",
-            "retry_after_ms": exc.retry_after_ms,
-            "detail": str(exc)}
+    out = {"error": "overloaded",
+           "retry_after_ms": exc.retry_after_ms,
+           "detail": str(exc)}
+    if exc.tier is not None:
+        out["tier"] = exc.tier
+    return out
 
 
 class DeadlineExceededError(RuntimeError):

@@ -55,7 +55,11 @@ tiebreak — the same policy `ReplicaSet` applies intra-process), with:
   replica; N consecutive ones can (docs/FLEET.md "Chaos runbook").
 - **load shedding**: total in-flight past `shed_high_water` answers
   503 + `Retry-After` + `{"error": "overloaded", ...}` before any
-  replica is touched.
+  replica is touched — PER TIER: the batch lane has its own lower
+  `batch_high_water` (default half the global mark) so bulk work sheds
+  while interactive admission still has headroom, and every shed reply
+  names the shed tier and derives Retry-After from THAT tier's backlog
+  (docs/FLEET.md "Per-tier shedding & autoscaling").
 - **rolling/canary reload** (`rolling_reload`): drain -> per-replica
   `POST /reload` -> `/readyz` probe (-> optional `/predict` validation
   probe) -> readmit, one replica at a time; the first replica is the
@@ -110,8 +114,12 @@ from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.scaleout.statetracker import InMemoryStateTracker
 from deeplearning4j_tpu.utils import procs
 from deeplearning4j_tpu.utils.statefile import StateFile
-from deeplearning4j_tpu.serving.errors import (DEADLINE_HEADER, Deadline,
-                                               OverloadedError)
+from deeplearning4j_tpu.serving.errors import (DEADLINE_HEADER,
+                                               PRIORITY_HEADER,
+                                               TIER_BATCH, TIER_INTERACTIVE,
+                                               TIERS, Deadline,
+                                               OverloadedError,
+                                               backlog_retry_ms)
 from deeplearning4j_tpu.serving.router import ReplicaClient
 
 __all__ = ["Fleet", "FleetReplica", "ReplicaSpawner", "Autoscaler",
@@ -130,6 +138,12 @@ EVICTED = "evicted"
 STATES = (STARTING, READY, SUSPECT, DRAINING, EVICTED)
 
 _fleet_seq = itertools.count()
+
+#: rough per-request drain estimate feeding tier-aware Retry-After at
+#: the fleet's shed sites: an interactive request is a short decode, a
+#: batch request is a bulk stream — a shed bulk client should back off
+#: proportionally longer (serving/errors.backlog_retry_ms)
+_TIER_ITEM_MS = {TIER_INTERACTIVE: 50.0, TIER_BATCH: 250.0}
 
 
 class NoReadyReplicas(RuntimeError):
@@ -380,24 +394,41 @@ class Autoscaler:
     `scale_down_at`, bounded by [min_replicas, max_replicas] with a
     cooldown between actions. Pure policy — the Fleet applies the
     decision (`Fleet.autoscale_tick`), so tests drive it with synthetic
-    load and a fake spawner."""
+    load and a fake spawner.
+
+    The BATCH tier feeds a second, backlog-shaped signal
+    (docs/FLEET.md "Per-tier shedding & autoscaling"): bulk streams
+    queue patiently behind replica admission instead of inflating
+    instantaneous queue depth the way an interactive burst does, so
+    batch scales up on `batch_backlog >= batch_backlog_up_at` (how much
+    bulk work is parked, not how fast it arrives) and the fleet never
+    scales DOWN while any batch backlog exists — idle capacity is
+    exactly what the bulk lane is there to soak."""
 
     def __init__(self, min_replicas: int = 1, max_replicas: int = 4,
                  scale_up_at: float = 4.0, scale_down_at: float = 0.5,
-                 cooldown_s: float = 10.0):
+                 cooldown_s: float = 10.0,
+                 batch_backlog_up_at: int = 8):
         if not 1 <= min_replicas <= max_replicas:
             raise ValueError(
                 f"need 1 <= min_replicas <= max_replicas, got "
                 f"{min_replicas}..{max_replicas}")
+        if batch_backlog_up_at < 1:
+            raise ValueError(
+                f"batch_backlog_up_at must be >= 1, got "
+                f"{batch_backlog_up_at}")
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
         self.scale_up_at = float(scale_up_at)
         self.scale_down_at = float(scale_down_at)
         self.cooldown_s = float(cooldown_s)
+        self.batch_backlog_up_at = int(batch_backlog_up_at)
         self._last_action = 0.0
 
-    def decide(self, n_replicas: int, outstanding: int) -> int:
-        """-1 / 0 / +1 given live replica count and total in-flight."""
+    def decide(self, n_replicas: int, outstanding: int,
+               batch_backlog: int = 0) -> int:
+        """-1 / 0 / +1 given live replica count, total in-flight, and
+        the batch tier's parked backlog."""
         if n_replicas < self.min_replicas:
             return 1  # below floor: act regardless of cooldown
         if time.monotonic() - self._last_action < self.cooldown_s:
@@ -405,7 +436,11 @@ class Autoscaler:
         per = outstanding / max(1, n_replicas)
         if per >= self.scale_up_at and n_replicas < self.max_replicas:
             return 1
-        if per <= self.scale_down_at and n_replicas > self.min_replicas:
+        if (batch_backlog >= self.batch_backlog_up_at
+                and n_replicas < self.max_replicas):
+            return 1
+        if (per <= self.scale_down_at and n_replicas > self.min_replicas
+                and batch_backlog == 0):
             return -1
         return 0
 
@@ -420,6 +455,7 @@ class Fleet:
                  heartbeat_interval: float = 0.5,
                  heartbeat_timeout: float = 3.0,
                  shed_high_water: Optional[int] = None,
+                 batch_high_water: Optional[int] = None,
                  probe_timeout: float = 2.0,
                  request_timeout: float = 60.0,
                  generate_timeout: float = 300.0,
@@ -436,6 +472,22 @@ class Fleet:
         self.autoscaler = autoscaler
         self.heartbeat_interval = float(heartbeat_interval)
         self.shed_high_water = shed_high_water
+        #: the BATCH tier's own (lower) high-water mark: bulk work
+        #: sheds while interactive admission is still wide open, so an
+        #: interactive burst always finds headroom. Default: half the
+        #: global mark. Per-tier in-flight is tracked fleet-side
+        #: (`_tier_inflight`, select/release twins).
+        if batch_high_water is not None:
+            if batch_high_water < 1:
+                raise ValueError(
+                    f"batch_high_water must be >= 1, got "
+                    f"{batch_high_water}")
+            self.batch_high_water: Optional[int] = int(batch_high_water)
+        elif shed_high_water is not None:
+            self.batch_high_water = max(1, int(shed_high_water) // 2)
+        else:
+            self.batch_high_water = None
+        self._tier_inflight = {t: 0 for t in TIERS}
         #: monitor probes use this short dedicated timeout, never the
         #: ReplicaClient default — and the sweep probes replicas
         #: CONCURRENTLY, so one hung replica costs the sweep one probe
@@ -562,6 +614,30 @@ class Fleet:
             "replayed tokens the router suppressed by absolute "
             "token_index so the client stream stays exactly-once "
             "across failover").labels(**lab)
+        tscope = {"scope": f"fleet:{self.label}"}
+        self._m_tier_requests = {
+            t: reg.counter(
+                "dl4j_tier_requests",
+                "requests admitted per SLO tier").labels(tier=t, **tscope)
+            for t in TIERS}
+        self._m_tier_shed = {
+            t: reg.counter(
+                "dl4j_tier_shed",
+                "requests shed per SLO tier (batch sheds at its own, "
+                "lower high-water mark)").labels(tier=t, **tscope)
+            for t in TIERS}
+        self._m_tier_latency = {
+            t: reg.histogram(
+                "dl4j_tier_request_latency_seconds",
+                "router-side request wall latency per SLO tier").labels(
+                    tier=t, **tscope)
+            for t in TIERS}
+        self._m_preempt_resumes = reg.counter(
+            "dl4j_tier_preempt_resumes",
+            "batch rows re-admitted after an interactive arrival "
+            "preempted their decode slot — the lossless durable-stream "
+            "resume path, distinct from failover resumes").labels(
+                tier=TIER_BATCH, **tscope)
         self._m_timeouts = reg.counter(
             "dl4j_fleet_request_timeouts",
             "request-path timeouts (the circuit breaker's input — a "
@@ -612,6 +688,22 @@ class Fleet:
             "in-flight requests across the fleet").labels(
                 **lab).set_function(
             lambda: (lambda o: o.total_outstanding() if o else 0)(ref()))
+        for t in TIERS:
+            reg.gauge(
+                "dl4j_tier_backlog",
+                "in-flight (or replica-queued) requests per SLO "
+                "tier").labels(tier=t, **tscope).set_function(
+                (lambda _t: lambda: (
+                    (lambda o: o._tier_inflight[_t] if o else 0)(
+                        ref())))(t))
+        reg.gauge(
+            "dl4j_fleet_utilization",
+            "fleet load as a fraction of shed capacity (outstanding / "
+            "shed_high_water; per-ready-replica outstanding when no "
+            "mark is set) — near 1.0 under a batch flood means the "
+            "bulk lane is soaking idle capacity").labels(
+                **lab).set_function(
+            lambda: (lambda o: o.utilization() if o else 0.0)(ref()))
         # crash-safe control plane (docs/OBSERVABILITY.md) — series
         # definitions shared with the supervisor (statefile module)
         from deeplearning4j_tpu.utils.statefile import \
@@ -1079,8 +1171,28 @@ class Fleet:
                 counts[r.breaker.state] += 1
             return counts
 
+    def utilization(self) -> float:
+        """Fleet load normalized to its shed capacity: outstanding /
+        shed_high_water when a mark is set (1.0 = shedding), else mean
+        outstanding per ready replica. The bench's "batch soaks idle
+        capacity" gauge (docs/OBSERVABILITY.md)."""
+        total = self.total_outstanding()
+        if self.shed_high_water:
+            return total / float(self.shed_high_water)
+        return total / float(max(1, self.ready_count()))
+
+    def batch_backlog(self) -> int:
+        """Batch-tier work parked on this fleet: bulk streams in
+        flight or queued behind replica admission (the router holds a
+        batch stream open while its rows wait for slots, so in-flight
+        IS the backlog). The autoscaler's batch signal."""
+        with self._lock:
+            return self._tier_inflight[TIER_BATCH]
+
     def select(self, route: str = "predict",
-               exclude: Sequence[str] = ()) -> FleetReplica:
+               exclude: Sequence[str] = (),
+               tier: str = TIER_INTERACTIVE,
+               count: bool = True) -> FleetReplica:
         """Least-outstanding READY replica (round-robin tiebreak) —
         the ReplicaSet policy lifted across processes. SUSPECT
         replicas (recent request timeouts, breaker not yet open) stay
@@ -1096,8 +1208,13 @@ class Fleet:
         `breaker_reset_s` — the replica re-enters the tiebreak rotation
         and the next request delivers the breaker its verdict either
         way. Sheds with OverloadedError past the global high-water
-        mark; raises NoReadyReplicas when nothing is admittable. The
-        caller owns `release()`."""
+        mark — and the BATCH tier additionally past its own, lower
+        `batch_high_water`, with Retry-After derived from the shed
+        tier's backlog. Raises NoReadyReplicas when nothing is
+        admittable. The caller owns `release(rep, tier)` (same tier)."""
+        if tier not in TIERS:
+            raise ValueError(
+                f"unknown tier {tier!r} (expected one of {TIERS})")
         with self._lock:
             now = time.monotonic()
             for r in self._replicas.values():
@@ -1115,38 +1232,66 @@ class Fleet:
             if not ready:
                 raise NoReadyReplicas(
                     f"no ready replica (states: {self.state_counts()})")
-            if self.shed_high_water is not None:
-                total = sum(r.outstanding
-                            for r in self._replicas.values())
-                if total >= self.shed_high_water:
-                    self._m_shed[route].inc()
-                    raise OverloadedError(
-                        f"fleet at high-water mark ({total} in flight "
-                        f">= {self.shed_high_water})",
-                        retry_after_ms=200)
+            total = sum(r.outstanding
+                        for r in self._replicas.values())
+            if (tier == TIER_BATCH and self.batch_high_water is not None
+                    and total >= self.batch_high_water):
+                # the bulk lane sheds FIRST, while interactive
+                # admission still has headroom up to the global mark
+                self._m_shed[route].inc()
+                self._m_tier_shed[TIER_BATCH].inc()
+                raise OverloadedError(
+                    f"fleet batch lane at high-water mark ({total} in "
+                    f"flight >= {self.batch_high_water})",
+                    retry_after_ms=backlog_retry_ms(
+                        self._tier_inflight[TIER_BATCH] + 1,
+                        _TIER_ITEM_MS[TIER_BATCH]),
+                    tier=TIER_BATCH)
+            if (self.shed_high_water is not None
+                    and total >= self.shed_high_water):
+                self._m_shed[route].inc()
+                self._m_tier_shed[tier].inc()
+                raise OverloadedError(
+                    f"fleet at high-water mark ({total} in flight "
+                    f">= {self.shed_high_water})",
+                    retry_after_ms=backlog_retry_ms(
+                        self._tier_inflight[tier] + 1,
+                        _TIER_ITEM_MS[tier]),
+                    tier=tier)
             n = len(ids)
             best = min(ready, key=lambda r: (
                 r.outstanding, r.state == SUSPECT,
                 (ids.index(r.id) - self._rr) % n))
             self._rr = (ids.index(best.id) + 1) % n
             best.outstanding += 1
-            if not exclude:
+            self._tier_inflight[tier] += 1
+            if not exclude and count:
                 # first attempt only: a retried client request counts
                 # ONCE in dl4j_fleet_requests (retries have their own
-                # counter), and retry attempts carry a non-empty
-                # exclude set by construction
+                # counter, retry attempts carry a non-empty exclude
+                # set by construction, and preemption re-admissions
+                # pass count=False — same client request)
                 self._m_requests[route].inc()
+                self._m_tier_requests[tier].inc()
             return best
 
-    def release(self, rep: FleetReplica) -> None:
+    def release(self, rep: FleetReplica,
+                tier: str = TIER_INTERACTIVE) -> None:
+        """Return a `select`ed replica; `tier` must match the select
+        call so per-tier in-flight accounting balances."""
         with self._lock:
             rep.outstanding -= 1
+            self._tier_inflight[tier] -= 1
 
-    def observe(self, route: str, seconds: float) -> None:
+    def observe(self, route: str, seconds: float,
+                tier: Optional[str] = None) -> None:
         self._m_latency[route].observe(seconds)
+        if tier is not None:
+            self._m_tier_latency[tier].observe(seconds)
 
     def forward_predict(self, body: bytes,
-                        deadline: Optional[Deadline] = None
+                        deadline: Optional[Deadline] = None,
+                        tier: str = TIER_INTERACTIVE
                         ) -> Tuple[int, dict, bytes]:
         """Route one /predict: least-loaded replica, transparent retry
         on a healthy peer after connection failures, request timeouts,
@@ -1156,8 +1301,10 @@ class Fleet:
         (remaining / attempts-left, capped by request_timeout) so a
         hung replica spends one slice and leaves room to retry, and
         the shrunk budget is forwarded downstream as `X-Deadline-Ms`.
-        Returns (status, headers, body) from the replica that
-        answered."""
+        The SLO `tier` gates admission (batch sheds at its own mark)
+        and is forwarded as `X-Priority` so the replica's batcher
+        applies its tiered queue bound too. Returns (status, headers,
+        body) from the replica that answered."""
         start = time.perf_counter()
         tried: set = set()
         last_5xx: Optional[Tuple[int, dict, bytes]] = None
@@ -1176,7 +1323,8 @@ class Fleet:
                     self._m_deadline["predict"].inc()
                     deadline.check("router retry")
                 try:
-                    rep = self.select(route="predict", exclude=tried)
+                    rep = self.select(route="predict", exclude=tried,
+                                      tier=tier)
                 except NoReadyReplicas:
                     break  # fall through to best-effort answer below
                 if tried:
@@ -1185,7 +1333,7 @@ class Fleet:
                     self._m_retries.inc()
                 if deadline is None:
                     hop_timeout = self.request_timeout
-                    headers = None
+                    headers = {}
                 else:
                     hop_timeout = max(0.05, min(
                         self.request_timeout,
@@ -1197,6 +1345,9 @@ class Fleet:
                     # of computing an answer nobody will read
                     headers = {DEADLINE_HEADER:
                                str(max(1, int(hop_timeout * 1000)))}
+                if tier != TIER_INTERACTIVE:
+                    headers[PRIORITY_HEADER] = tier
+                headers = headers or None
                 # a timeout at a deadline-sliced window shorter than a
                 # fair request_timeout says the CLIENT was impatient,
                 # not that the replica hung — it must not feed the
@@ -1216,7 +1367,7 @@ class Fleet:
                     last_err = e
                     continue
                 finally:
-                    self.release(rep)
+                    self.release(rep, tier)
                 if status >= 500:
                     # replica answered but failed/shed: try a peer,
                     # keep the reply in case every peer does the same
@@ -1231,7 +1382,8 @@ class Fleet:
                 "every ready replica failed /predict"
                 + (f" (last error: {last_err})" if last_err else ""))
         finally:
-            self.observe("predict", time.perf_counter() - start)
+            self.observe("predict", time.perf_counter() - start,
+                         tier=tier)
 
     # --------------------------------------------------- rolling reload
     def _drain(self, rep: FleetReplica, timeout: float) -> bool:
@@ -1443,7 +1595,9 @@ class Fleet:
                     if r.state in (READY, SUSPECT, STARTING)]
             outstanding = sum(r.outstanding
                               for r in self._replicas.values())
-        delta = self.autoscaler.decide(len(live), outstanding)
+            batch_backlog = self._tier_inflight[TIER_BATCH]
+        delta = self.autoscaler.decide(len(live), outstanding,
+                                       batch_backlog=batch_backlog)
         if delta > 0:
             self.spawn(1)
             self.autoscaler.note_action()
@@ -1511,6 +1665,16 @@ class Fleet:
                                   self._m_deadline.items()},
             "shed": {route: int(c.value)
                      for route, c in self._m_shed.items()},
+            "tiers": {
+                "batch_high_water": self.batch_high_water,
+                "inflight": {t: self._tier_inflight[t] for t in TIERS},
+                "requests": {t: int(c.value) for t, c
+                             in self._m_tier_requests.items()},
+                "shed": {t: int(c.value) for t, c
+                         in self._m_tier_shed.items()},
+                "preempt_resumes": int(self._m_preempt_resumes.value),
+                "utilization": round(self.utilization(), 4),
+            },
             "evictions": int(self._m_evictions.value),
             "readmissions": int(self._m_readmissions.value),
             "reloads": {outcome: int(c.value)
@@ -1522,5 +1686,7 @@ class Fleet:
                 "max_replicas": self.autoscaler.max_replicas,
                 "scale_up_at": self.autoscaler.scale_up_at,
                 "scale_down_at": self.autoscaler.scale_down_at,
+                "batch_backlog_up_at":
+                    self.autoscaler.batch_backlog_up_at,
             }),
         }

@@ -15,7 +15,9 @@ Two pieces live here (the fleet state machine itself is
   - ``POST /predict``  — least-outstanding ready replica; connection
     failures and replica 5xx retry transparently on a healthy peer
     (idempotent, so at-least-once is safe); total-outstanding past the
-    fleet's high-water mark sheds with 503 + Retry-After.
+    fleet's high-water mark sheds with 503 + Retry-After — per SLO
+    tier: an `X-Priority: batch` request sheds at the batch lane's
+    own lower mark and the header is forwarded to the replica.
   - ``POST /generate`` — DURABLE streams (docs/FLEET.md "Stream
     failover"): the router always drives the replica in streaming mode
     and keeps a per-stream continuation record — the request spec plus
@@ -35,6 +37,17 @@ Two pieces live here (the fleet state machine itself is
     in-band as the final NDJSON line after it. Bodies the router can't
     parse into a continuation record degrade to the legacy blind
     passthrough (no resume).
+
+    The SAME machinery makes slot preemption lossless
+    (docs/SERVING.md "Priority tiers"): a batch row whose decode slot
+    was evicted for an interactive arrival comes back with
+    ``finish_reason: "preempted"`` — the router treats that as
+    NON-terminal, keeps the row's continuation record, and re-admits
+    it on the next free slot exactly like a failover resume, except it
+    burns no ``stream_resume_attempts`` budget and excludes no
+    replica (the preempting replica is healthy). A shed re-admission
+    (503: the batch lane is full) waits out the tier-aware
+    ``Retry-After`` and tries again.
   - ``POST /reload``   — rolling/canary reload across the fleet
     (drain -> per-replica /reload -> /readyz probe -> readmit, one at
     a time; automatic rollback when the canary fails — Fleet.rolling_reload).
@@ -58,17 +71,31 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler
 from typing import Optional, Tuple
 
-from deeplearning4j_tpu.serving.errors import (DEADLINE_HEADER, Deadline,
+from deeplearning4j_tpu.serving.errors import (DEADLINE_HEADER,
+                                               PRIORITY_HEADER,
+                                               TIER_INTERACTIVE, Deadline,
                                                DeadlineExceededError,
                                                OverloadedError,
                                                deadline_body,
-                                               overload_body,
+                                               overload_body, parse_tier,
                                                replica_failed_body)
 from deeplearning4j_tpu.telemetry import exposition
 from deeplearning4j_tpu.testing import chaos
 from deeplearning4j_tpu.utils.httpd import ServerHandle, start_http_server
 
 __all__ = ["ReplicaClient", "FleetHandle", "serve_fleet"]
+
+
+#: safety valves on the lossless-preemption loop. A batch stream under
+#: constant interactive pressure can be preempted and re-admitted many
+#: times (that is the design), but a pathological flood must not pin a
+#: router thread forever: after this many preemption re-admissions the
+#: stream fails with the in-band retryable shape instead.
+_PREEMPT_RESUME_CAP = 64
+#: ... and a re-admission that keeps getting SHED (batch lane full)
+#: waits out Retry-After at most this many times (each wait is bounded
+#: at 5s, so the worst case is minutes, not forever).
+_PREEMPT_SHED_WAITS_CAP = 600
 
 
 class _ClientGone(Exception):
@@ -361,8 +388,13 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             # header-borne budget (clients of the router speak the
             # header; the router forwards the SHRUNK remainder)
             deadline = Deadline.from_request(self.headers)
+            # header-borne tier too: /predict bodies are forwarded
+            # raw, so only `X-Priority` reaches the fleet's per-tier
+            # admission here (a body-only "priority" field is still
+            # honored by the replica's own batcher)
+            tier = parse_tier(self.headers)
             status, headers, data = fleet.forward_predict(
-                self._body, deadline=deadline)
+                self._body, deadline=deadline, tier=tier)
             ctype = headers.get("Content-Type", "application/json")
             extra = [("Retry-After", headers["Retry-After"])] \
                 if "Retry-After" in headers else []
@@ -374,27 +406,32 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             self.end_headers()
             self.wfile.write(data)
 
-        def _hop_budget(self, deadline):
+        def _hop_budget(self, deadline, tier=TIER_INTERACTIVE):
             """Per-attempt (timeout, forwarded-headers, breaker-
             eligible) derived from the REMAINING budget — recomputed on
             every resume hop so the forwarded `X-Deadline-Ms` only ever
-            shrinks. A timeout at a deadline-sliced window shorter than
-            a fair wait says the CLIENT was impatient, not that the
-            replica hung — same eligibility rule forward_predict
-            applies (fleet.note_request_failure's contract)."""
+            shrinks, plus the forwarded `X-Priority` so the replica's
+            decode admission applies the same tier. A timeout at a
+            deadline-sliced window shorter than a fair wait says the
+            CLIENT was impatient, not that the replica hung — same
+            eligibility rule forward_predict applies
+            (fleet.note_request_failure's contract)."""
             if deadline is None:
-                hop_timeout, fwd_headers = fleet.generate_timeout, None
+                hop_timeout, fwd_headers = fleet.generate_timeout, {}
             else:
                 hop_timeout = deadline.timeout(fleet.generate_timeout)
                 fwd_headers = {DEADLINE_HEADER: deadline.header_value()}
+            if tier != TIER_INTERACTIVE:
+                fwd_headers[PRIORITY_HEADER] = tier
             eligible = hop_timeout >= min(fleet.generate_timeout,
                                           fleet.probe_timeout)
-            return hop_timeout, fwd_headers, eligible
+            return hop_timeout, fwd_headers or None, eligible
 
         def _generate(self):
             data = self._read_json()  # parsed for stream/deadline
             streaming = bool(data.get("stream", False))
             deadline = Deadline.from_request(self.headers, data)
+            tier = parse_tier(self.headers, data)  # unknown -> 400
             if deadline is not None and deadline.expired:
                 fleet._m_deadline["generate"].inc()
                 deadline.check("router dispatch")  # raises -> 504
@@ -402,22 +439,31 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             start = time.perf_counter()
             try:
                 if parsed is None:
-                    self._generate_passthrough(streaming, deadline)
+                    self._generate_passthrough(streaming, deadline,
+                                               tier)
                 else:
-                    self._generate_durable(parsed, streaming, deadline)
+                    self._generate_durable(parsed, streaming, deadline,
+                                           tier)
             except _ClientGone:
                 self.close_connection = True
             finally:
-                fleet.observe("generate", time.perf_counter() - start)
+                fleet.observe("generate", time.perf_counter() - start,
+                              tier=tier)
 
-        def _generate_durable(self, parsed, streaming, deadline):
+        def _generate_durable(self, parsed, streaming, deadline, tier):
             """Failover-durable /generate: drive the replica in
             streaming mode (even for a non-streaming client), fold its
             NDJSON into the continuation record, and on replica failure
             re-admit the unfinished rows on a survivor with
             `prompt + delivered` as the new context. The client's
             response headers are sent LAZILY — while no byte has been
-            relayed, a total failure can still answer a clean 502."""
+            relayed, a total failure can still answer a clean 502.
+
+            Preemption rides the same loop: rows finishing with
+            `"preempted"` stay non-terminal and re-admit on the next
+            iteration — with `attempt` still 0, so a preemption resume
+            burns no failover budget, excludes no replica, and a shed
+            re-admission waits out the tier-aware Retry-After."""
             import http.client as _hc
 
             rows, eos_id, use_prefix, use_spec = parsed
@@ -425,6 +471,9 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             failed = []        # replica ids excluded from resume placement
             resumes = 0        # successful re-admissions (stream opened)
             resume_tried = 0   # resume attempts started (reported on fail)
+            preempt_resumes = 0  # lossless preemption re-admissions
+            preempt_waits = 0    # shed re-admissions waited out
+            preempt_pending = False  # next stream-open IS a preempt resume
             state = {"headers_sent": False}
 
             def chunk(obj: dict) -> None:
@@ -462,9 +511,12 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                         else None
                         for r in rows]
                 if streaming:
-                    chunk({"done": True, "tokens": toks,
-                           "finish_reasons": reasons,
-                           "resumes": resumes})
+                    done_line = {"done": True, "tokens": toks,
+                                 "finish_reasons": reasons,
+                                 "resumes": resumes}
+                    if preempt_resumes:
+                        done_line["preempt_resumes"] = preempt_resumes
+                    chunk(done_line)
                     end_chunked()
                 elif "deadline_exceeded" in reasons:
                     self._reply(504, {"error": "deadline_exceeded",
@@ -478,6 +530,8 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                     out = {"tokens": toks, "finish_reasons": reasons}
                     if resumes:
                         out["resumes"] = resumes
+                    if preempt_resumes:
+                        out["preempt_resumes"] = preempt_resumes
                     self._reply(200, out)
 
             def reply_inband(obj: dict) -> None:
@@ -532,15 +586,35 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                         continue
                     try:
                         replica = fleet.select(route="generate",
-                                               exclude=tuple(failed))
+                                               exclude=tuple(failed),
+                                               tier=tier)
                     except (NoReadyReplicas, OverloadedError) as e:
                         reply_failed(last[0], f"{last[1]}; no surviving "
                                      f"replica to resume on ({e})")
                         return
                 else:
-                    replica = fleet.select(route="generate")
+                    try:
+                        replica = fleet.select(
+                            route="generate", tier=tier,
+                            count=not preempt_pending)
+                    except OverloadedError:
+                        if not preempt_pending:
+                            raise  # initial admission: shed the client
+                        # a preemption re-admission shed at the FLEET
+                        # mark: same backpressure as a replica-side
+                        # 503 — wait a beat and try again
+                        preempt_waits += 1
+                        if preempt_waits > _PREEMPT_SHED_WAITS_CAP or (
+                                deadline is not None
+                                and deadline.expired):
+                            reply_failed(last[0], "preempted stream "
+                                         "could not re-admit (fleet "
+                                         "overloaded)")
+                            return
+                        time.sleep(0.2)
+                        continue
                 hop_timeout, fwd_headers, eligible = \
-                    self._hop_budget(deadline)
+                    self._hop_budget(deadline, tier)
                 body = {
                     # replay context: everything the client already has
                     "prompt": [r.prompt + r.delivered for r in pending],
@@ -577,6 +651,50 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                         continue
                     if resp.status != 200:
                         raw = resp.read()
+                        if preempt_pending and attempt == 0:
+                            if resp.status == 503:
+                                # a preemption re-admission was SHED
+                                # (batch lane full): honor the
+                                # tier-aware Retry-After, bounded by
+                                # the remaining budget — backpressure,
+                                # not failure; no failover budget
+                                # burned, nobody excluded
+                                fleet.note_request_success(replica)
+                                preempt_waits += 1
+                                if preempt_waits > \
+                                        _PREEMPT_SHED_WAITS_CAP:
+                                    reply_failed(
+                                        replica.id,
+                                        "preempted stream could not "
+                                        "re-admit (lane stayed full)")
+                                    return
+                                ra = resp.getheader("Retry-After")
+                                try:
+                                    wait = (min(float(ra), 5.0)
+                                            if ra else 0.2)
+                                except ValueError:
+                                    wait = 0.2
+                                if deadline is not None:
+                                    if deadline.expired:
+                                        reply_failed(
+                                            replica.id,
+                                            "deadline spent re-"
+                                            "admitting a preempted "
+                                            "stream")
+                                        return
+                                    wait = min(wait, max(
+                                        0.05, deadline.remaining_s()))
+                                time.sleep(wait)
+                                continue
+                            # any other refusal mid-preemption-resume:
+                            # headers may already be out, so speak the
+                            # in-band retryable shape, never a raw
+                            # status line
+                            reply_failed(
+                                replica.id,
+                                "preempted stream re-admission "
+                                f"refused: HTTP {resp.status}")
+                            return
                         if attempt > 0:
                             # a survivor refusing the resume (shedding,
                             # validation): exclude it and keep going
@@ -612,6 +730,15 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                         resumes += 1
                         fleet._m_stream_resumes.inc()
                         fleet._m_stream_tokens_replayed.inc(replayed)
+                    elif preempt_pending:
+                        # a lossless preemption re-admission opened:
+                        # counted apart from failover resumes, but the
+                        # replayed-context accounting is the same (the
+                        # prefix cache absorbs the replay either way)
+                        preempt_resumes += 1
+                        fleet._m_preempt_resumes.inc()
+                        fleet._m_stream_tokens_replayed.inc(replayed)
+                    preempt_pending = False
                     kind, payload = self._relay_continuation(
                         resp, pending, eos_id,
                         chunk if streaming else None)
@@ -627,12 +754,27 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                     if kind == "inband":
                         reply_inband(payload)
                         return
+                    if kind == "preempted":
+                        # `payload` rows lost their batch slot to an
+                        # interactive arrival; their continuation
+                        # records are intact, so the next iteration
+                        # re-admits them — attempt stays 0 (no
+                        # failover budget burned, no exclusion)
+                        if preempt_resumes >= _PREEMPT_RESUME_CAP:
+                            reply_failed(
+                                replica.id,
+                                f"preempted {preempt_resumes} times "
+                                "without finishing (resume cap)")
+                            return
+                        preempt_pending = True
+                        last = (replica.id, "slot preempted")
+                        continue
                     # kind == "done": loop re-checks pending (empty
                     # unless the replica under-reported — it won't)
                 finally:
                     if conn is not None:
                         conn.close()
-                    fleet.release(replica)
+                    fleet.release(replica, tier)
 
         def _relay_continuation(self, resp, pending, eos_id, emit):
             """Fold one replica's NDJSON stream into the continuation
@@ -640,6 +782,10 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             a non-streaming client). Returns:
 
             - ("done", None)    — the replica finished every row;
+            - ("preempted", n)  — the stream ended cleanly but n rows
+              lost their batch slot to an interactive arrival
+              (`finish_reason: "preempted"`); their records stay
+              NON-terminal and the caller re-admits them losslessly;
             - ("inband", obj)   — terminal in-band error object
               (deadline and friends — NOT a replica failure);
             - ("broken", exc)   — the replica died / hung / broke the
@@ -668,11 +814,22 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                             "undecodable stream line from replica"))
                     if obj.get("done"):
                         reasons = obj.get("finish_reasons") or []
+                        n_preempted = 0
                         for li, row in enumerate(pending):
                             if row.finish_reason is None:
-                                row.finish_reason = (
-                                    reasons[li] if li < len(reasons)
-                                    else "error")
+                                reason = (reasons[li]
+                                          if li < len(reasons)
+                                          else "error")
+                                if reason == "preempted":
+                                    # NOT terminal: the row keeps its
+                                    # continuation record and the
+                                    # caller re-admits it on the next
+                                    # free slot (lossless preemption)
+                                    n_preempted += 1
+                                else:
+                                    row.finish_reason = reason
+                        if n_preempted:
+                            return ("preempted", n_preempted)
                         return ("done", None)
                     if "token" in obj:
                         li = obj.get("row", 0)
@@ -742,18 +899,20 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             self.end_headers()
             self.wfile.write(data)
 
-        def _generate_passthrough(self, streaming, deadline):
+        def _generate_passthrough(self, streaming, deadline,
+                                  tier=TIER_INTERACTIVE):
             """The pre-failover path, kept for bodies that don't parse
             into a continuation record (string prompts, exotic fields,
             a client that is itself a resuming router): one replica,
-            blind relay, no resume."""
-            replica = fleet.select(route="generate")
+            blind relay, no resume (a preempted row surfaces its
+            `"preempted"` finish_reason to the client unresumed)."""
+            replica = fleet.select(route="generate", tier=tier)
             import http.client as _hc
 
             replica_errs = (OSError, _hc.HTTPException)
             try:
                 hop_timeout, fwd_headers, eligible = \
-                    self._hop_budget(deadline)
+                    self._hop_budget(deadline, tier)
                 try:
                     conn, resp = replica.client.open(
                         "POST", "/generate", self._body,
@@ -800,7 +959,7 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                 finally:
                     conn.close()
             finally:
-                fleet.release(replica)
+                fleet.release(replica, tier)
 
         def _relay_stream(self, replica, resp,
                           breaker_eligible: bool = True) -> None:

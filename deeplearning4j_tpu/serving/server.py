@@ -72,7 +72,8 @@ from deeplearning4j_tpu.serving.errors import (Deadline,
                                                DeadlineExceededError,
                                                OverloadedError,
                                                deadline_body,
-                                               overload_body)
+                                               overload_body,
+                                               parse_tier)
 from deeplearning4j_tpu.serving.replicas import ReplicaSet
 from deeplearning4j_tpu.telemetry import exposition
 from deeplearning4j_tpu.testing import chaos
@@ -258,6 +259,7 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                   drafter: str = "ngram",
                   draft_params=None, draft_cfg=None,
                   draft_window: int = 32,
+                  batch_share: float = 0.5,
                   host: str = "127.0.0.1", port: int = 0,
                   warmup_shape=None,
                   warmup_async: bool = False,
@@ -292,7 +294,12 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
     docs/SERVING.md "Speculative decoding"). `checkpoint` ({path, step})
     stamps the initial checkpoint identity on the replicas when the
     served model came from a checkpoint — /readyz, /stats, and the
-    fleet journal report it (docs/PIPELINE.md).
+    fleet journal report it (docs/PIPELINE.md). Requests carry an SLO
+    tier (`X-Priority` header or `"priority"` body field, interactive
+    default): batch-tier work rides the bulk lane — shed first at
+    lower water marks, admitted behind interactive, preemptible —
+    and `batch_share` tunes its weighted-fair slice of the decode
+    slots (docs/SERVING.md "Priority tiers").
     """
     if replicas is None:
         if net is None:
@@ -322,7 +329,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                                           drafter=drafter,
                                           draft_params=draft_params,
                                           draft_cfg=draft_cfg,
-                                          draft_window=draft_window)
+                                          draft_window=draft_window,
+                                          batch_share=batch_share)
     batcher = replicas.batcher(max_batch_size=max_batch_size,
                                max_delay_ms=max_delay_ms,
                                max_queue=max_queue)
@@ -449,11 +457,16 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
         def _predict(self):
             data = self._read_json()
             deadline = Deadline.from_request(self.headers, data)
+            # SLO tier: X-Priority header wins, else "priority" body
+            # field (interactive default; unknown values 400)
+            tier = parse_tier(self.headers, data)
             chaos.hit("server.predict")
             inputs = np.asarray(data["inputs"], np.float32)
             # batcher.submit sheds an already-expired budget before
-            # enqueueing, and re-checks at dispatch
-            fut: Future = batcher.submit(inputs, deadline=deadline)
+            # enqueueing, and re-checks at dispatch; batch-tier
+            # requests shed first at the lower water mark
+            fut: Future = batcher.submit(inputs, deadline=deadline,
+                                         tier=tier)
             wait_s = (_RESULT_TIMEOUT_S if deadline is None
                       else deadline.timeout(_RESULT_TIMEOUT_S))
             try:
@@ -517,6 +530,13 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                 return
             data = self._read_json()
             deadline = Deadline.from_request(self.headers, data)
+            # SLO tier: X-Priority header wins, else "priority" body
+            # field (interactive default; unknown values 400). Batch
+            # rides the weighted-fair bulk lane and may be PREEMPTED —
+            # the stream then finishes with reason "preempted" and its
+            # already-emitted tokens still relay (the fleet router
+            # turns that into a lossless durable-stream resume)
+            tier = parse_tier(self.headers, data)
             chaos.hit("server.generate")
             raw = data["prompt"]
             if not isinstance(raw, list) or not raw:
@@ -580,7 +600,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                                        deadline=deadline,
                                        prefix_cache=use_prefix,
                                        token_index_base=base,
-                                       speculation=use_spec)
+                                       speculation=use_spec,
+                                       tier=tier)
             if streaming:
                 self._stream_tokens(streams, deadline)
                 return

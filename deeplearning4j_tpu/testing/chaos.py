@@ -69,6 +69,10 @@ POINTS = {
     "server.generate": "before /generate admission into the decode loop",
     "generate.midstream": "between streamed /generate chunks (in-band "
                           "error or hard socket reset mid-stream)",
+    "decode.step": "decode loop, at the top of every scheduler pass "
+                   "(tick) — a delay rule paces decode itself so SLO "
+                   "drills can hold slot occupancy open; an error "
+                   "fails every in-flight stream loudly",
     "decode.fork": "decode loop's copy-on-write page fork, after the "
                    "destination page is claimed (possibly by evicting "
                    "a cached prefix page) but before the device copy "

@@ -73,6 +73,13 @@ def pytest_configure(config):
         "spec: speculative-decoding lane (serving/speculation.py + the "
         "DecodeLoop draft-and-verify dispatch); deterministic drills "
         "run in tier-1 — run just this layer with pytest -m spec")
+    config.addinivalue_line(
+        "markers",
+        "slo: SLO-tier lane (priority classes, weighted-fair batch "
+        "share, lossless preemption — docs/SERVING.md \"Priority "
+        "tiers\"); the in-process drills run in tier-1, the "
+        "SIGKILL-mid-preemption process drill also carries @slow — "
+        "run the whole layer with pytest -m slo")
 
 
 def pytest_collection_modifyitems(config, items):

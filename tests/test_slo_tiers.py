@@ -1,0 +1,739 @@
+"""SLO tiers + lossless preemption (docs/SERVING.md "Priority tiers").
+
+The serving path carries two priority classes end to end — `X-Priority`
+header / `"priority"` body field, `interactive` (default) and `batch` —
+and this file drills every layer of that claim:
+
+1. **Tier plumbing**: `parse_tier` (header wins, body fallback, loud
+   400 on a typo), `backlog_retry_ms` (floor/cap), and the per-tier
+   request accounting on the fleet snapshot.
+2. **Preemption at the replica**: batch streams fill idle slots; a
+   blocked interactive arrival evicts one, the victim finishes with
+   `finish_reason: "preempted"`, its already-emitted tokens intact —
+   and the three-way page invariant (in-use + free + cached-unref ==
+   n_pages) holds tick-by-tick through the churn.
+3. **Lossless preemption through the router**: the durable-stream
+   machinery turns "preempted" into a resume record and re-admits the
+   row, so a flooded batch stream still delivers its FULL budget —
+   gapless `token_index`, duplicate-free, bit-identical to a calm
+   reference — while interactive traffic cuts through the flood.
+4. **Per-tier shedding**: the batch lane sheds FIRST at its own lower
+   high-water mark, with a tier-tagged 503 whose Retry-After is
+   derived from the batch backlog; interactive admission stays open.
+5. **Batch-backlog autoscaling**: parked bulk work scales the fleet
+   up, and never lets it scale down.
+6. **`cli batch`**: the bulk client's crash-safe cursor — exactly-once
+   output rows across a mid-run restart, sha-pinned input identity.
+7. **Chaos drill (@slow)**: slot preemption COMBINED with a replica
+   SIGKILL mid-preempted-stream — zero lost or duplicated batch rows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import (Fleet, InferenceEngine,
+                                        serve_fleet, serve_network)
+from deeplearning4j_tpu.serving.errors import (PRIORITY_HEADER,
+                                               TIER_BATCH,
+                                               TIER_INTERACTIVE, TIERS,
+                                               backlog_retry_ms,
+                                               parse_tier)
+from deeplearning4j_tpu.serving.fleet import Autoscaler
+from deeplearning4j_tpu.testing import chaos
+from deeplearning4j_tpu.testing.chaos import Rule
+
+pytestmark = pytest.mark.slo
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    chaos.deactivate()
+
+
+def _post(url, payload, timeout=120, headers=()):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(dict(headers))
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 headers=hdrs)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _stream(url, payload, timeout=300, headers=()):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(dict(headers))
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 headers=hdrs)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return [json.loads(ln) for ln in r if ln.strip()]
+
+
+def _net(n_in=4, n_out=3):
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(n_in).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=n_out)
+            .pretrain(False).build())
+    return MultiLayerNetwork(conf)
+
+
+@pytest.fixture(scope="module")
+def tf_setup():
+    import jax
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig, init_transformer_params)
+
+    cfg = TransformerConfig(vocab_size=17, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_len=96,
+                            interpret=True)
+    return init_transformer_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+PROMPT = [1, 2, 3, 4, 5, 6, 7, 8]
+BATCH_TOKENS = 48
+INTER_TOKENS = 4
+
+
+def _token_events(events):
+    return [e for e in events if "token" in e]
+
+
+def _assert_balance(loop):
+    """Three-way page invariant: every pool page is in exactly one of
+    in-use (ref > 0), the free list, or the cached-unreferenced tier —
+    preemption retires victims through the SAME path as any finish, so
+    the churn must never leak or double-own a page."""
+    in_use = loop.pages_in_use
+    free = len(loop._free)
+    cached_unref = loop._cached_unref()
+    assert in_use + free + cached_unref == loop.n_pages, (
+        in_use, free, cached_unref, loop.n_pages)
+
+
+class _BalanceWatch:
+    """Background tick-by-tick invariant poller over a live loop."""
+
+    def __init__(self, loop, period=0.005):
+        self.loop, self.period = loop, period
+        self.violations = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                with self.loop._cond:
+                    _assert_balance(self.loop)
+            except AssertionError as e:
+                self.violations.append(str(e))
+            time.sleep(self.period)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+        return self.violations
+
+
+class _TieredFleet:
+    """One in-process replica (4 slots, batch_share 0.5) behind a
+    router — small enough that four batch streams saturate it and the
+    first interactive arrival must preempt."""
+
+    def __init__(self, tf_setup, **fleet_kw):
+        params, cfg = tf_setup
+        self.gen = InferenceEngine.for_transformer(params, cfg,
+                                                   prefix_cache=True)
+        self.handle = serve_network(
+            _net(), n_replicas=1, max_delay_ms=1.0,
+            generate_engine=self.gen, slots=4, page_size=8,
+            prefix_cache=True)
+        fleet_kw.setdefault("heartbeat_timeout", 5.0)
+        self.fleet = Fleet(start=False, **fleet_kw)
+        self.fleet.attach(self.handle.url)
+        for _ in range(200):
+            self.fleet.poll()
+            if self.fleet.ready_count() >= 1:
+                break
+            time.sleep(0.02)
+        assert self.fleet.ready_count() >= 1
+        self.router = serve_fleet(self.fleet)
+
+    @property
+    def url(self):
+        return self.router.url
+
+    @property
+    def loop(self):
+        return self.gen.decode_loop
+
+    def close(self):
+        self.router.close()
+        self.handle.close()
+
+
+# ================================================== tier plumbing units
+class TestTierParsing:
+    def test_default_is_interactive(self):
+        assert parse_tier() == TIER_INTERACTIVE
+        assert parse_tier({}, {}) == TIER_INTERACTIVE
+
+    def test_header_wins_over_body(self):
+        assert parse_tier({PRIORITY_HEADER: "batch"},
+                          {"priority": "interactive"}) == TIER_BATCH
+
+    def test_body_fallback_and_normalization(self):
+        assert parse_tier({}, {"priority": "batch"}) == TIER_BATCH
+        assert parse_tier({PRIORITY_HEADER: " Batch "}) == TIER_BATCH
+
+    def test_unknown_tier_fails_loudly(self):
+        with pytest.raises(ValueError, match="bacth"):
+            parse_tier({}, {"priority": "bacth"})
+        assert set(TIERS) == {TIER_INTERACTIVE, TIER_BATCH}
+
+    def test_backlog_retry_floor_and_cap(self):
+        assert backlog_retry_ms(0, 250.0) == 50          # floor
+        assert backlog_retry_ms(4, 250.0) == 1000        # 4 * 250ms
+        assert backlog_retry_ms(10_000, 250.0) == 30_000  # cap
+        # deeper backlog never shortens the advice
+        prev = 0
+        for backlog in (0, 1, 2, 8, 64, 512):
+            ms = backlog_retry_ms(backlog, 250.0)
+            assert ms >= prev
+            prev = ms
+
+
+class TestBatchBacklogAutoscaling:
+    def test_parked_batch_backlog_scales_up(self):
+        a = Autoscaler(min_replicas=1, max_replicas=4, scale_up_at=4.0,
+                       cooldown_s=0.0, batch_backlog_up_at=2)
+        # bulk streams queue patiently: queue depth alone says "calm"
+        assert a.decide(2, outstanding=2, batch_backlog=0) == 0
+        # ...but parked bulk work is the batch lane's real signal
+        assert a.decide(2, outstanding=2, batch_backlog=2) == 1
+
+    def test_never_scales_down_under_batch_backlog(self):
+        a = Autoscaler(min_replicas=1, max_replicas=4,
+                       scale_down_at=0.5, cooldown_s=0.0,
+                       batch_backlog_up_at=8)
+        assert a.decide(3, outstanding=0, batch_backlog=0) == -1
+        # idle capacity is what the bulk lane is there to soak
+        assert a.decide(3, outstanding=0, batch_backlog=1) == 0
+
+    def test_backlog_threshold_validated(self):
+        with pytest.raises(ValueError, match="batch_backlog_up_at"):
+            Autoscaler(batch_backlog_up_at=0)
+
+
+# ======================================= replica-level preemption (HTTP)
+class TestReplicaPreemption:
+    def test_batch_fills_idle_slots_then_interactive_preempts(
+            self, tf_setup):
+        """Idle fleet: batch takes every slot (the fair-share cap binds
+        only while interactive work waits). A blocked interactive
+        arrival evicts the cheapest batch victim, which finishes with
+        `finish_reason: "preempted"` and a gapless prefix of its
+        tokens; the page pool balances tick-by-tick throughout."""
+        params, cfg = tf_setup
+        gen = InferenceEngine.for_transformer(params, cfg,
+                                              prefix_cache=True)
+        handle = serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                               generate_engine=gen, slots=4,
+                               page_size=8, prefix_cache=True)
+        loop = gen.decode_loop
+        watch = None
+        try:
+            # warm pass compiles the decode program before the drill
+            calm = _stream(f"{handle.url}/generate",
+                           {"prompt": [PROMPT], "max_tokens": 8,
+                            "stream": True, "priority": "batch"})
+            ref8 = [e["token"] for e in _token_events(calm)]
+            assert len(ref8) == 8
+
+            watch = _BalanceWatch(loop)
+            results = [None] * 4
+            failures = []
+
+            def worker(i):
+                try:
+                    results[i] = _stream(
+                        f"{handle.url}/generate",
+                        {"prompt": [PROMPT],
+                         "max_tokens": BATCH_TOKENS, "stream": True},
+                        headers={PRIORITY_HEADER: TIER_BATCH})
+                except Exception as e:  # noqa: BLE001
+                    failures.append(repr(e))
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        daemon=True) for i in range(4)]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if loop.snapshot()["tiers"]["occupied"][TIER_BATCH] >= 4:
+                    break
+                time.sleep(0.005)
+            assert loop.snapshot()["tiers"]["occupied"][TIER_BATCH] >= 4
+
+            # blocked interactive arrival -> preempt, not a 503
+            out = _post(f"{handle.url}/generate",
+                        {"prompt": [PROMPT],
+                         "max_tokens": INTER_TOKENS})
+            assert out["tokens"] == [PROMPT + ref8[:INTER_TOKENS]]
+            assert out["finish_reasons"] == ["max_tokens"]
+
+            for t in threads:
+                t.join(timeout=300)
+            assert failures == []
+            stats = loop.snapshot()
+            assert stats["tiers"]["preemptions"] >= 1
+            # at least one victim: reason "preempted", tokens a gapless
+            # PREFIX of the reference (nothing lost, nothing invented)
+            preempted = 0
+            for ev in results:
+                toks = _token_events(ev)
+                idx = [e["token_index"] for e in toks]
+                assert idx == list(range(len(idx)))
+                done = ev[-1]
+                assert done["done"]
+                for reason in done["finish_reasons"]:
+                    assert reason in ("max_tokens", "preempted")
+                    preempted += reason == "preempted"
+            assert preempted >= 1
+            assert stats["tiers"]["requests"][TIER_BATCH] >= 4
+            assert stats["tiers"]["requests"][TIER_INTERACTIVE] >= 1
+        finally:
+            violations = watch.stop() if watch is not None else []
+            handle.close()
+        assert violations == []
+        assert loop.pages_in_use == 0
+
+
+# ============================== router-level lossless preemption (HTTP)
+class TestLosslessPreemptionViaRouter:
+    def test_preempted_batch_streams_finish_lossless(self, tf_setup):
+        """The ISSUE flagship, in-process: four batch streams saturate
+        the slots, interactive probes punch through the flood (each one
+        preempting a batch victim), and the router's durable-stream
+        resume re-admits every victim — each batch stream still
+        delivers its FULL budget, gapless and bit-identical to the calm
+        reference, with `preempt_resumes` visible on the done line and
+        the fleet snapshot."""
+        pair = _TieredFleet(tf_setup)
+        watch = None
+        try:
+            ref = _stream(f"{pair.url}/generate",
+                          {"prompt": [PROMPT],
+                           "max_tokens": BATCH_TOKENS, "stream": True,
+                           "priority": "batch"})
+            ref_toks = [e["token"] for e in _token_events(ref)]
+            assert len(ref_toks) == BATCH_TOKENS
+
+            watch = _BalanceWatch(pair.loop)
+            results = [None] * 4
+            failures = []
+
+            def worker(i):
+                try:
+                    results[i] = _stream(
+                        f"{pair.url}/generate",
+                        {"prompt": [PROMPT],
+                         "max_tokens": BATCH_TOKENS, "stream": True},
+                        headers={PRIORITY_HEADER: TIER_BATCH})
+                except Exception as e:  # noqa: BLE001
+                    failures.append(repr(e))
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        daemon=True) for i in range(4)]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                occ = pair.loop.snapshot()["tiers"]["occupied"]
+                if occ[TIER_BATCH] >= 4:
+                    break
+                time.sleep(0.005)
+
+            # interactive probes through the flood: every one lands
+            for _ in range(3):
+                out = _post(f"{pair.url}/generate",
+                            {"prompt": [PROMPT],
+                             "max_tokens": INTER_TOKENS})
+                assert out["tokens"] == \
+                    [PROMPT + ref_toks[:INTER_TOKENS]]
+
+            for t in threads:
+                t.join(timeout=300)
+            assert failures == []
+
+            # lossless: full budget, zero gaps, zero dups, reference-
+            # identical — preemption is invisible except for the count
+            client_resumes = 0
+            for ev in results:
+                toks = _token_events(ev)
+                assert [e["token_index"] for e in toks] == \
+                    list(range(BATCH_TOKENS))
+                assert [e["token"] for e in toks] == ref_toks
+                done = ev[-1]
+                assert done["done"]
+                assert done["finish_reasons"] == ["max_tokens"]
+                assert done["tokens"] == [PROMPT + ref_toks]
+                client_resumes += done.get("preempt_resumes", 0)
+            assert client_resumes >= 1
+
+            snap = pair.fleet.snapshot()
+            assert snap["tiers"]["preempt_resumes"] >= 1
+            assert snap["tiers"]["requests"][TIER_BATCH] >= 5
+            assert snap["tiers"]["requests"][TIER_INTERACTIVE] >= 3
+            # preemption resumes are NOT failover resumes: no replica
+            # failed, so the failover counter stays untouched
+            assert snap["stream_resumes"] == 0
+            assert pair.loop.snapshot()["tiers"]["preemptions"] >= 1
+        finally:
+            violations = watch.stop() if watch is not None else []
+            pair.close()
+        assert violations == []
+
+    def test_interactive_unaffected_when_batch_share_free(self,
+                                                          tf_setup):
+        """No contention, batch under its share: nothing preempts, and
+        both tiers' latency accounting lands on the snapshot."""
+        pair = _TieredFleet(tf_setup)
+        try:
+            out_b = _post(f"{pair.url}/generate",
+                          {"prompt": [PROMPT], "max_tokens": 4,
+                           "priority": "batch"})
+            out_i = _post(f"{pair.url}/generate",
+                          {"prompt": [PROMPT], "max_tokens": 4})
+            assert out_b["tokens"] == out_i["tokens"]
+            assert pair.loop.snapshot()["tiers"]["preemptions"] == 0
+            snap = pair.fleet.snapshot()
+            assert snap["tiers"]["requests"][TIER_BATCH] == 1
+            assert snap["tiers"]["requests"][TIER_INTERACTIVE] == 1
+            assert snap["tiers"]["preempt_resumes"] == 0
+        finally:
+            pair.close()
+
+
+# ======================================== per-tier shedding (HTTP 503s)
+class TestPerTierShedding:
+    def test_batch_sheds_first_interactive_stays_open(self, tf_setup):
+        """batch_high_water=1: with ONE request in flight fleet-wide,
+        the batch lane is full (tier-tagged 503, backlog-derived
+        Retry-After) while interactive admission — and its headroom up
+        to shed_high_water — is untouched."""
+        chaos.configure([Rule("generate.midstream", "delay",
+                              delay_s=0.02)])
+        pair = _TieredFleet(tf_setup, shed_high_water=8,
+                            batch_high_water=1)
+        try:
+            # warm pass (no load: batch admits below the mark)
+            warm = _post(f"{pair.url}/predict",
+                         {"inputs": [[0.0, 0.0, 0.0, 0.0]]},
+                         headers={PRIORITY_HEADER: TIER_BATCH})
+            assert "outputs" in warm
+
+            hold = []
+
+            def holder():
+                hold.append(_stream(
+                    f"{pair.url}/generate",
+                    {"prompt": [PROMPT], "max_tokens": 32,
+                     "stream": True}))
+
+            t = threading.Thread(target=holder, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 30.0
+            while pair.fleet.total_outstanding() < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                _post(f"{pair.url}/predict",
+                      {"inputs": [[0.0, 0.0, 0.0, 0.0]]},
+                      headers={PRIORITY_HEADER: TIER_BATCH})
+            err = exc_info.value
+            body = json.loads(err.read())
+            assert err.code == 503
+            assert body["error"] == "overloaded"
+            assert body["tier"] == TIER_BATCH
+            assert body["retry_after_ms"] >= 50
+            assert int(err.headers["Retry-After"]) >= 1
+
+            # the interactive lane never felt it
+            ok = _post(f"{pair.url}/predict",
+                       {"inputs": [[0.0, 0.0, 0.0, 0.0]]})
+            assert "outputs" in ok
+
+            t.join(timeout=300)
+            assert hold and hold[0][-1]["done"]
+            snap = pair.fleet.snapshot()
+            assert snap["tiers"]["shed"][TIER_BATCH] >= 1
+            assert snap["tiers"]["shed"][TIER_INTERACTIVE] == 0
+            assert snap["tiers"]["batch_high_water"] == 1
+            assert 0.0 <= snap["tiers"]["utilization"] <= 1.0
+        finally:
+            pair.close()
+
+
+# ================================================= cli batch bulk client
+class TestCliBatchClient:
+    def _args(self, url, inp, outp, **kw):
+        base = dict(url=url, input=inp, output=outp, journal=None,
+                    max_tokens=6, batch_size=2, eos_id=None,
+                    timeout=120.0, max_shed_retries=10, progress=False)
+        base.update(kw)
+        return SimpleNamespace(**base)
+
+    def test_bulk_run_then_crash_resume_exactly_once(self, tf_setup,
+                                                     tmp_path,
+                                                     capsys):
+        """Six prompt rows through the router on the batch tier; then a
+        simulated crash (cursor rolled back to 2, plus an uncommitted
+        tail row in the output) — the resume truncates the tail,
+        re-runs rows 2..5, and the final output holds every row exactly
+        once, in order, identical to the uninterrupted run."""
+        from deeplearning4j_tpu import cli
+
+        pair = _TieredFleet(tf_setup)
+        inp = str(tmp_path / "prompts.jsonl")
+        outp = str(tmp_path / "out.jsonl")
+        try:
+            with open(inp, "w") as f:
+                for i in range(6):
+                    f.write(json.dumps(PROMPT[:4 + (i % 3)]) + "\n")
+                # one row overrides its own budget
+            assert cli.cmd_batch(self._args(pair.url, inp, outp)) == 0
+            done = json.loads(
+                capsys.readouterr().out.strip().splitlines()[-1])
+            assert done["batch_done"] and done["rows"] == 6
+            assert done["resumed_at"] == 0
+
+            with open(outp) as f:
+                first = [json.loads(ln) for ln in f]
+            assert [r["row"] for r in first] == list(range(6))
+            assert all(len(r["tokens"]) == 4 + (i % 3) + 6
+                       for i, r in enumerate(first))
+
+            # crash simulation: journal says 2 rows committed, output
+            # carries 3 (the third fsynced but never committed)
+            journal = outp + ".journal"
+            with open(journal) as f:
+                state = json.load(f)
+            assert state["cursor"] == 6
+            state["cursor"] = 2
+            with open(journal, "w") as f:
+                json.dump(state, f)
+            with open(outp, "w") as f:
+                for r in first[:3]:
+                    f.write(json.dumps(r) + "\n")
+
+            assert cli.cmd_batch(self._args(pair.url, inp, outp)) == 0
+            done = json.loads(
+                capsys.readouterr().out.strip().splitlines()[-1])
+            assert done["resumed_at"] == 2
+            with open(outp) as f:
+                second = [json.loads(ln) for ln in f]
+            # exactly once, in order, bit-identical to the first run
+            assert second == first
+        finally:
+            pair.close()
+
+    def test_input_identity_is_pinned(self, tf_setup, tmp_path,
+                                      capsys):
+        """A journal committed against one input refuses to resume
+        against another (sha mismatch) — silent cross-file resumes
+        would interleave unrelated rows."""
+        from deeplearning4j_tpu import cli
+
+        pair = _TieredFleet(tf_setup)
+        inp = str(tmp_path / "prompts.jsonl")
+        outp = str(tmp_path / "out.jsonl")
+        try:
+            with open(inp, "w") as f:
+                f.write(json.dumps(PROMPT) + "\n")
+            assert cli.cmd_batch(self._args(pair.url, inp, outp)) == 0
+            capsys.readouterr()
+            with open(inp, "a") as f:
+                f.write(json.dumps(PROMPT) + "\n")
+            assert cli.cmd_batch(self._args(pair.url, inp, outp)) == 2
+        finally:
+            pair.close()
+
+
+# ===================================== process chaos drill (slow lane)
+def _spawner(tmp_path, slow_ms=30, step_ms=0):
+    from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
+    from deeplearning4j_tpu.serving.fleet import ReplicaSpawner
+
+    ckpt = str(tmp_path / "slo.ckpt")
+    DefaultModelSaver(ckpt, keep_old=False).save(_net())
+    spec = str(tmp_path / "tf.json")
+    with open(spec, "w") as f:
+        json.dump({"vocab_size": 17, "d_model": 32, "n_heads": 2,
+                   "n_layers": 2, "d_ff": 64, "max_len": 96,
+                   "interpret": True, "seed": 0}, f)
+    rules = [Rule("generate.midstream", "delay",
+                  delay_s=slow_ms / 1000.0)]
+    if step_ms:
+        # pace the decode scheduler itself: with the compile cache hot
+        # a subprocess replica decodes ~2 ms/token, so an unpaced flood
+        # frees every slot before an interactive probe can arrive —
+        # occupancy (and therefore preemption) needs a held-open window
+        rules.append(Rule("decode.step", "delay",
+                          delay_s=step_ms / 1000.0))
+    env = dict(os.environ,
+               PYTHONPATH=REPO_ROOT + os.pathsep
+               + os.environ.get("PYTHONPATH", ""),
+               JAX_PLATFORMS="cpu",
+               **chaos.env_spec(rules))
+    return ReplicaSpawner(ckpt,
+                          serve_args=["--max-delay-ms", "1",
+                                      "--transformer", spec,
+                                      "--slots", "4",
+                                      "--page-size", "8",
+                                      "--batch-share", "0.5"],
+                          env=env)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestPreemptionPlusSigkillDrill:
+    def test_sigkill_mid_preempted_stream_zero_lost_rows(self,
+                                                         tmp_path):
+        """The compound fault: batch streams get PREEMPTED by
+        interactive probes, and while their resume records are
+        mid-flight the serving replica is SIGKILLED. Both recovery
+        machines (preemption re-admission and mid-stream failover) run
+        back to back on the same rows — every batch stream must still
+        deliver its full budget with zero lost and zero duplicated
+        rows, gapless `token_index`, bit-identical to the calm
+        reference, and the survivor's page pool must balance (all
+        pages home) when the dust settles."""
+        n_tokens = 48
+        n_streams = 8  # 2 replicas x 4 slots: ZERO idle slots anywhere
+        fleet = Fleet(spawner=_spawner(tmp_path, slow_ms=5, step_ms=40),
+                      heartbeat_interval=0.2, heartbeat_timeout=3.0,
+                      breaker_threshold=2, breaker_reset_s=0.4)
+        router = None
+        try:
+            fleet.spawn(2)
+            fleet.wait_ready(2, timeout=300)
+            router = serve_fleet(fleet)
+            ref = _stream(f"{router.url}/generate",
+                          {"prompt": [PROMPT], "max_tokens": n_tokens,
+                           "stream": True, "priority": "batch"})
+            ref_toks = [e["token"] for e in _token_events(ref)]
+            assert len(ref_toks) == n_tokens
+
+            results = [None] * n_streams
+            failures = []
+
+            def worker(i):
+                try:
+                    results[i] = _stream(
+                        f"{router.url}/generate",
+                        {"prompt": [PROMPT], "max_tokens": n_tokens,
+                         "stream": True},
+                        headers={PRIORITY_HEADER: TIER_BATCH})
+                except Exception as e:  # noqa: BLE001
+                    failures.append(repr(e))
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        daemon=True)
+                       for i in range(n_streams)]
+            for t in threads:
+                t.start()
+            # wait until the flood OCCUPIES every decode slot on BOTH
+            # replicas (the least-loaded dispatch splits it 4/4), so an
+            # interactive arrival cannot find a free slot anywhere —
+            # router-side `outstanding` is not enough, it also counts
+            # streams whose decode finished but whose relay lags
+            def _saturated():
+                for r in fleet._replicas.values():
+                    d = r.client.stats()["generate"]["decode"]
+                    if d["tiers"]["occupied"][TIER_BATCH] < 4:
+                        return False
+                return True
+
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and not _saturated():
+                time.sleep(0.02)
+            assert _saturated()
+
+            # interactive probes MUST preempt a batch slot to land —
+            # each completing probe leaves a preempted row mid-resume
+            for _ in range(2):
+                out = _post(f"{router.url}/generate",
+                            {"prompt": [PROMPT], "max_tokens": 4},
+                            timeout=300)
+                assert out["tokens"] == [PROMPT + ref_toks[:4]]
+
+            # the preemption machine has observably fired BEFORE the
+            # kill: the router re-admitted at least one preempted row
+            # (streaming headers flush at admission, so the counter
+            # ticks while the continuation is still queued)
+            deadline = time.monotonic() + 30.0
+            while (time.monotonic() < deadline
+                   and fleet.snapshot()["tiers"]["preempt_resumes"] < 1):
+                time.sleep(0.02)
+            assert fleet.snapshot()["tiers"]["preempt_resumes"] >= 1
+
+            # ...and the kill lands on a loaded replica while the
+            # paced decode still holds its streams mid-flight
+            victim = max(fleet._replicas.values(),
+                         key=lambda r: r.outstanding)
+            assert victim.outstanding >= 1
+            chaos.sigkill(victim.proc)
+            for t in threads:
+                t.join(timeout=300)
+            assert failures == []
+
+            for ev in results:
+                toks = _token_events(ev)
+                assert [e["token_index"] for e in toks] == \
+                    list(range(n_tokens))
+                assert [e["token"] for e in toks] == ref_toks
+                done = ev[-1]
+                assert done["done"]
+                assert done["finish_reasons"] == ["max_tokens"]
+                assert done["tokens"] == [PROMPT + ref_toks]
+
+            snap = fleet.snapshot()
+            # BOTH recovery machines fired across the drill
+            assert snap["tiers"]["preempt_resumes"] >= 1
+            assert snap["stream_resumes"] >= 1
+            # every page comes home on the survivor
+            survivor = next(r for r in fleet._replicas.values()
+                            if r.id != victim.id)
+            deadline = time.monotonic() + 15.0
+            dec = None
+            while time.monotonic() < deadline:
+                dec = survivor.client.stats()["generate"]["decode"]
+                if dec["pages_in_use"] == 0:
+                    break
+                time.sleep(0.1)
+            assert dec["pages_in_use"] == 0
+            assert dec["decode_step_programs"] == 1
+        finally:
+            if router is not None:
+                router.close(stop_replicas=True)
+            else:
+                fleet.close(stop_replicas=True)
