@@ -3060,6 +3060,186 @@ def bench_paged_kernel():
             "pages_per_slot": pages_per_slot(cfg, ps)}
 
 
+def bench_warmup():
+    """AOT warm start (docs/WARMUP.md): spawn `cli serve
+    --compile-cache DIR --warmup-plan auto` replica processes against
+    ONE cache directory — cold (empty cache: compile + persist + record
+    the plan) then warm (plan replay: AOT loads, zero compiles) — and
+    gate the subsystem's contract:
+
+    - warm warmup_seconds (the /readyz-gating phase: socket-open to
+      ready) >= 3x faster than cold;
+    - warm boot reports recompiled_after_warmup == 0 on /stats with
+      cache hits scraped LIVE off /metrics;
+    - chaos leg: a replica with compile.cache_read faulted at every
+      ordinal still reaches ready and serves correct predictions
+      (cold-compile fallback, zero request errors);
+    - trainer leg: cold-vs-warm first `fit()` wall in fresh
+      subprocesses riding the same store.
+
+    Spawn-to-ready wall is recorded too, but the gate rides the warmup
+    phase: interpreter + jax import (identical both ways) would
+    otherwise drown the signal on the CPU smoke."""
+    import json as _json
+    import shutil
+    import tempfile
+    import urllib.request
+
+    from deeplearning4j_tpu.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
+    from deeplearning4j_tpu.serving.fleet import ReplicaSpawner
+    from deeplearning4j_tpu.testing import chaos
+
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(16).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([32])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=4)
+            .pretrain(False).build())
+    work = tempfile.mkdtemp(prefix="dl4j_bench_warmup_")
+    ckpt = os.path.join(work, "warm.ckpt")
+    cache = os.path.join(work, "compile_cache")
+    DefaultModelSaver(ckpt, keep_old=False).save(MultiLayerNetwork(conf))
+    body = _json.dumps(
+        {"inputs": np.random.RandomState(0).rand(4, 16).tolist()}
+    ).encode()
+
+    def _get(url, timeout=10):
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+
+    def boot(extra_env=None):
+        """Spawn one replica; returns its measurements and kills it."""
+        env = dict(os.environ)
+        if extra_env:
+            env.update(extra_env)
+        spawner = ReplicaSpawner(
+            ckpt, env=env,
+            serve_args=["--compile-cache", cache, "--warmup-plan",
+                        "auto", "--max-delay-ms", "1"])
+        t0 = time.perf_counter()
+        proc, url = spawner.spawn()
+        try:
+            ready = None
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                try:
+                    status, raw = _get(url + "/readyz", timeout=5)
+                    if status == 200:
+                        ready = _json.loads(raw)
+                        break
+                except Exception:  # noqa: BLE001 — 503 until warm
+                    pass
+                time.sleep(0.05)
+            wall = time.perf_counter() - t0
+            if ready is None:
+                raise RuntimeError("replica never became ready")
+            errors = 0
+            for _ in range(8):
+                try:
+                    req = urllib.request.Request(
+                        url + "/predict", data=body,
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        out = _json.loads(r.read())
+                    if len(out["outputs"]) != 4:
+                        errors += 1
+                except Exception:  # noqa: BLE001
+                    errors += 1
+            _, stats_raw = _get(url + "/stats", timeout=30)
+            stats = _json.loads(stats_raw)
+            _, metrics_raw = _get(url + "/metrics", timeout=30)
+            scraped = {}
+            for line in metrics_raw.decode().splitlines():
+                for name in ("dl4j_compile_cache_hits_total",
+                             "dl4j_compile_cache_misses_total"):
+                    if line.startswith(name + " "):
+                        scraped[name] = float(line.split()[-1])
+            return {"spawn_to_ready_s": round(wall, 3),
+                    "warmup_s": ready.get("warmup_seconds"),
+                    "warmup": stats.get("warmup"),
+                    "compile_cache": stats.get("compile_cache"),
+                    "metrics": scraped,
+                    "predict_errors": errors}
+        finally:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    try:
+        cold = boot()
+        warm = boot()
+        chaotic = boot(chaos.env_spec(
+            [chaos.Rule("compile.cache_read", "error")], seed=0))
+
+        ratio = (cold["warmup_s"] / warm["warmup_s"]
+                 if cold["warmup_s"] and warm["warmup_s"] else None)
+        warm_hits = warm["metrics"].get(
+            "dl4j_compile_cache_hits_total", 0.0)
+        recompiled = (warm.get("warmup") or {}).get(
+            "recompiled_after_warmup")
+
+        # trainer leg: first fit() in a fresh process, cold vs warm
+        train_cache = os.path.join(work, "train_cache")
+        script = (
+            "import sys,time,numpy as np\n"
+            "from deeplearning4j_tpu import compilecache as cc\n"
+            "from deeplearning4j_tpu.config import "
+            "NeuralNetConfiguration\n"
+            "from deeplearning4j_tpu.nn.multilayer import "
+            "MultiLayerNetwork\n"
+            "conf=(NeuralNetConfiguration.builder().lr(0.1).n_in(16)"
+            ".activation_function('tanh')"
+            ".optimization_algo('iteration_gradient_descent')"
+            ".num_iterations(1).use_adagrad(False).list(2)"
+            ".hidden_layer_sizes([32])"
+            ".override(1,layer='output',loss_function='mcxent',"
+            "activation_function='softmax',n_out=4)"
+            ".pretrain(False).build())\n"
+            "cc.activate(sys.argv[1])\n"
+            "x=np.random.RandomState(0).rand(32,16).astype('float32')\n"
+            "y=np.eye(4,dtype='float32')"
+            "[np.random.RandomState(1).randint(0,4,32)]\n"
+            "t0=time.perf_counter()\n"
+            "MultiLayerNetwork(conf).fit(x,y,epochs=1)\n"
+            "print('FIT_S', time.perf_counter()-t0)\n"
+            "print('HITS', cc.stats()['hits'])\n")
+
+        def run_fit():
+            import sys
+
+            env = dict(os.environ)
+            env["PYTHONPATH"] = HERE + os.pathsep + env.get(
+                "PYTHONPATH", "")
+            out = subprocess.run(
+                [sys.executable, "-c", script, train_cache],
+                capture_output=True, text=True, timeout=300, env=env)
+            vals = dict(line.split() for line in out.stdout.splitlines()
+                        if line.startswith(("FIT_S", "HITS")))
+            return float(vals["FIT_S"]), int(vals["HITS"])
+
+        fit_cold_s, _ = run_fit()
+        fit_warm_s, fit_warm_hits = run_fit()
+
+        return {
+            "value": round(ratio, 2) if ratio else None,
+            "unit": "x_warmup_speedup",
+            "gate_3x": bool(ratio and ratio >= 3.0),
+            "gate_zero_recompiles": recompiled == 0,
+            "gate_live_hits": bool(warm_hits >= 1),
+            "gate_chaos_clean": bool(
+                chaotic["predict_errors"] == 0),
+            "cold": cold, "warm": warm, "chaos": chaotic,
+            "trainer": {"cold_fit_s": round(fit_cold_s, 3),
+                        "warm_fit_s": round(fit_warm_s, 3),
+                        "warm_hits": fit_warm_hits},
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 CONFIGS = {
     "mlp": bench_mlp,
     "feed": bench_feed,
@@ -3069,6 +3249,7 @@ CONFIGS = {
     "speculative": bench_speculative,
     "fleet": bench_fleet,
     "chaos": bench_chaos,
+    "warmup": bench_warmup,
     "stream_failover": bench_stream_failover,
     "slo_tiers": bench_slo_tiers,
     "train_elastic": bench_train_elastic,
@@ -3094,6 +3275,7 @@ METRIC_NAMES = {
     "speculative": "serving_speculative_tokens_per_dispatch_speedup",
     "fleet": "fleet_predict_rows_per_sec_4_replicas",
     "chaos": "chaos_sigstop_breaker_eviction_s",
+    "warmup": "serving_warm_boot_warmup_speedup",
     "stream_failover": "serving_stream_failover_p99_ttnt_ms",
     "slo_tiers": "serving_interactive_p99_under_batch_flood_ms",
     "train_elastic": "train_elastic_kill_recovery_s",

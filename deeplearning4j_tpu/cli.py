@@ -108,6 +108,25 @@ def _transformer_engine(spec: str):
     return InferenceEngine.for_transformer(params, cfg)
 
 
+def _activate_compile_cache(spec: Optional[str],
+                            anchor: Optional[str]) -> Optional[str]:
+    """`--compile-cache DIR|auto|off`: open the persistent AOT program
+    cache BEFORE any engine/trainer jit is constructed (docs/WARMUP.md).
+    `auto` co-locates the cache with `anchor` (the checkpoint/model
+    dir) when one exists; with no flag at all the process still
+    inherits `DL4J_TPU_COMPILE_CACHE` from a spawning parent lazily.
+    Returns the active cache dir (for the announce line) or None."""
+    from deeplearning4j_tpu import compilecache
+
+    if spec and spec != "off":
+        if spec == "auto":
+            if not anchor or not os.path.isdir(anchor):
+                return compilecache.active_dir()
+            spec = compilecache.default_dir_for_checkpoints(anchor)
+        compilecache.activate(spec)
+    return compilecache.active_dir()
+
+
 def _model_n_out(net) -> Optional[int]:
     try:
         return net.conf.confs[-1].n_out or None
@@ -153,6 +172,13 @@ class _Telemetry:
 def cmd_train(args) -> int:
     from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
 
+    # before jit construction AND before the elastic supervisor builds
+    # its WorkerSpawner (which exports the cache dir to every worker)
+    if args.checkpoint_dir and getattr(args, "compile_cache", None) \
+            == "auto":
+        os.makedirs(args.checkpoint_dir, exist_ok=True)
+    _activate_compile_cache(getattr(args, "compile_cache", None),
+                            args.checkpoint_dir)
     if args.elastic:
         return _cmd_train_elastic(args)
     tele = _Telemetry(args)
@@ -415,6 +441,11 @@ def cmd_serve(args) -> int:
 
     tele = _Telemetry(args)
     try:
+        # activate BEFORE model/engine construction so every jit the
+        # serving stack builds goes through the AOT store
+        cache_dir = _activate_compile_cache(
+            args.compile_cache,
+            args.model if os.path.isdir(args.model) else None)
         net = _load_model(args.model)
         n_in = net.conf.confs[0].n_in
         # initial checkpoint identity for /readyz//stats: what this
@@ -454,7 +485,8 @@ def cmd_serve(args) -> int:
             draft_params=draft_params, draft_cfg=draft_cfg,
             draft_window=args.draft_window,
             warmup_shape=(n_in,) if (args.warmup and n_in) else None,
-            warmup_async=args.warmup_async)
+            warmup_async=args.warmup_async,
+            warmup_plan=args.warmup_plan)
     except BaseException:
         tele.close()
         raise
@@ -499,6 +531,8 @@ def cmd_serve(args) -> int:
                                   and args.speculation else None),
                           },
                       },
+                      "compile_cache": cache_dir,
+                      "warmup_plan": handle.warmup_plan_path,
                       "metrics": handle.url + "/metrics",
                       **tele.announce()}), flush=True)
     if args.smoke:  # start/stop sanity check (tests, deploy probes)
@@ -534,6 +568,11 @@ def cmd_fleet(args) -> int:
         lo, _, hi = args.autoscale.partition(":")
         autoscaler = Autoscaler(min_replicas=int(lo),
                                 max_replicas=int(hi or lo))
+    # activate before the spawner snapshots its child environment: every
+    # replica (initial, autoscaled, respawned) inherits the warm cache
+    _activate_compile_cache(
+        getattr(args, "compile_cache", None),
+        args.model if args.model and os.path.isdir(args.model) else None)
     spawner = None
     if args.model and (args.replicas > 0 or autoscaler is not None):
         spawner = ReplicaSpawner(args.model, serve_args=args.serve_arg)
@@ -989,6 +1028,10 @@ def cmd_pipeline(args) -> int:
     probe = None
     if args.probe:
         probe = json.loads(args.probe)
+    # canary replicas the controller promotes should boot warm too:
+    # activate here so the spawned fleet's child env carries the cache
+    _activate_compile_cache(getattr(args, "compile_cache", None),
+                            args.checkpoint_dir)
     tele = _Telemetry(args)
     fleet = None
     handle = None
@@ -1156,6 +1199,13 @@ def build_parser() -> argparse.ArgumentParser:
                               "its surviving workers warm instead of "
                               "respawning them "
                               "(docs/FAULT_TOLERANCE.md)")
+    p_train.add_argument("--compile-cache", default=None,
+                         metavar="DIR|auto|off",
+                         help="persistent AOT program cache for the "
+                              "jitted train/eval steps; `auto` "
+                              "co-locates with --checkpoint-dir "
+                              "(docs/WARMUP.md). Elastic workers "
+                              "inherit it through the spawner env")
     telemetry_flags(p_train)
     p_train.set_defaults(fn=cmd_train)
 
@@ -1271,6 +1321,21 @@ def build_parser() -> argparse.ArgumentParser:
                               "interactive requests wait — interactive "
                               "preempts batch slots past it, losslessly "
                               "(docs/SERVING.md \"Priority tiers\")")
+    p_serve.add_argument("--compile-cache", default=None,
+                         metavar="DIR|auto|off",
+                         help="persistent AOT program cache: warm "
+                              "boots load serialized executables "
+                              "instead of recompiling (docs/WARMUP.md)."
+                              " `auto` co-locates with a model/"
+                              "checkpoint DIR; unset still inherits "
+                              "DL4J_TPU_COMPILE_CACHE from a spawner")
+    p_serve.add_argument("--warmup-plan", default="auto",
+                         metavar="auto|off|PATH",
+                         help="warmup plan to replay at boot (the "
+                              "program set a previous replica compiled)"
+                              " and to record at shutdown; `auto` "
+                              "stores it inside the compile cache, "
+                              "`off` disables plan replay/recording")
     p_serve.add_argument("--smoke", action="store_true",
                          help="start, print the address, shut down")
     telemetry_flags(p_serve)
@@ -1346,6 +1411,13 @@ def build_parser() -> argparse.ArgumentParser:
                               "re-adopts the warm fleet via /readyz — "
                               "zero respawns, zero recompiles "
                               "(docs/FLEET.md router-restart runbook)")
+    p_fleet.add_argument("--compile-cache", default=None,
+                         metavar="DIR|auto|off",
+                         help="persistent AOT program cache exported "
+                              "to every spawned replica: respawns and "
+                              "autoscale spin-ups boot warm "
+                              "(docs/WARMUP.md); `auto` co-locates "
+                              "with a model/checkpoint DIR")
     p_fleet.add_argument("--smoke", action="store_true",
                          help="start, print the address, shut down "
                               "(stops spawned replicas)")
@@ -1504,6 +1576,12 @@ def build_parser() -> argparse.ArgumentParser:
     p_pipe.add_argument("--cycles", type=int, default=None, metavar="N",
                         help="exit 0 after N watch cycles (default: "
                              "run until stopped)")
+    p_pipe.add_argument("--compile-cache", default=None,
+                        metavar="DIR|auto|off",
+                        help="persistent AOT program cache exported to "
+                             "canary/promoted replicas; `auto` "
+                             "co-locates with the watched checkpoint "
+                             "dir (docs/WARMUP.md)")
     p_pipe.add_argument("--smoke", action="store_true",
                         help="start, print the announce line, shut down")
     telemetry_flags(p_pipe)

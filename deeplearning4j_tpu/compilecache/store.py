@@ -1,0 +1,344 @@
+"""Persistent compiled-program store: fingerprinted, crash-atomic, LRU.
+
+The disk layer of the AOT warm-start subsystem (docs/WARMUP.md). A
+`ProgramStore` owns one cache directory and keeps serialized XLA
+executables (`jax.experimental.serialize_executable` payloads) in it,
+one file per program key, under a RUNTIME FINGERPRINT subdirectory:
+
+    <root>/v1/<fingerprint>/<key-digest>.xc
+
+The fingerprint hashes jax/jaxlib versions, the backend platform, and
+the device topology — a cache written by a different runtime is never
+even looked at (stale entries can only produce wrong or unloadable
+programs; quarantining by construction beats validating on load). On
+open, any OTHER fingerprint's subtree is swept and counted as
+`dl4j_compile_cache_evictions{reason="fingerprint"}`.
+
+Entry format: a small header (magic + payload CRC32 + length) followed
+by the pickled `(payload, in_tree, out_tree)` triple. Writes are
+crash-atomic with the repo's one durability idiom (utils/statefile.py,
+checkpoint/format.py): tmp write -> fsync -> `os.replace`. A reader
+can therefore see only the previous entry or the new one; anything
+else (external truncation, a torn copy of the directory) fails the CRC
+and is deleted — skipped, never loaded (`reason="torn"`).
+
+Size is bounded by an LRU byte budget: after each write the store
+evicts oldest-read entries (mtime order; `get` touches mtime) until
+under budget (`reason="lru"`).
+
+Fault injection: chaos points `compile.cache_write` (op="write" before
+the tmp write, op="rename" before the commit rename) and
+`compile.cache_read` (before each entry read). Every failure path —
+injected or real IO — DEGRADES: `put` returns False, `get` returns
+None, and the caller compiles like the cache never existed. The cache
+must never be able to take serving down.
+"""
+
+from __future__ import annotations
+
+import binascii
+import hashlib
+import logging
+import os
+import struct
+from typing import Dict, Optional
+
+from deeplearning4j_tpu.testing import chaos
+
+__all__ = ["ProgramStore", "runtime_fingerprint", "key_digest"]
+
+log = logging.getLogger(__name__)
+
+_MAGIC = b"DL4JXC1\n"
+_HEADER = struct.Struct(">II")  # crc32, payload length
+_LAYOUT = "v1"
+_SUFFIX = ".xc"
+
+#: default LRU byte budget (override per-store or via
+#: DL4J_TPU_COMPILE_CACHE_BUDGET_MB)
+DEFAULT_BUDGET_BYTES = 512 * 1024 * 1024
+BUDGET_ENV = "DL4J_TPU_COMPILE_CACHE_BUDGET_MB"
+
+
+def runtime_fingerprint() -> str:
+    """Digest of everything that can invalidate a serialized executable:
+    jax + jaxlib versions, backend platform, device kind and count, and
+    the XLA flags the process was launched with. Two processes with the
+    same fingerprint can exchange compiled programs; anything else must
+    not even try."""
+    import jax
+
+    try:
+        import jaxlib
+        jaxlib_ver = getattr(jaxlib, "__version__", "?")
+    except Exception:  # pragma: no cover — jaxlib always ships with jax
+        jaxlib_ver = "?"
+    devs = jax.devices()
+    parts = [
+        f"jax={jax.__version__}",
+        f"jaxlib={jaxlib_ver}",
+        f"platform={jax.default_backend()}",
+        f"device={devs[0].device_kind if devs else 'none'}",
+        f"count={len(devs)}",
+        f"xla_flags={os.environ.get('XLA_FLAGS', '')}",
+    ]
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
+def key_digest(key: str) -> str:
+    """Stable filename for an arbitrary program key (keys embed shapes,
+    dtypes, and config digests — too long and too hostile for paths)."""
+    return hashlib.sha256(key.encode()).hexdigest()[:32]
+
+
+class ProgramStore:
+    """One compiled-program cache directory (see module docstring)."""
+
+    def __init__(self, root: str, *,
+                 size_budget_bytes: Optional[int] = None,
+                 fingerprint: Optional[str] = None):
+        self.root = os.path.abspath(root)
+        if size_budget_bytes is None:
+            mb = os.environ.get(BUDGET_ENV)
+            size_budget_bytes = (int(float(mb) * 1024 * 1024) if mb
+                                 else DEFAULT_BUDGET_BYTES)
+        self.size_budget_bytes = int(size_budget_bytes)
+        self.fingerprint = fingerprint or runtime_fingerprint()
+        self.dir = os.path.join(self.root, _LAYOUT, self.fingerprint)
+        from deeplearning4j_tpu import telemetry
+
+        reg = telemetry.get_registry()
+        self._m_hits = reg.counter(
+            "dl4j_compile_cache_hits",
+            "compiled programs loaded from the persistent cache "
+            "(tracing AND XLA compilation skipped)")
+        self._m_misses = reg.counter(
+            "dl4j_compile_cache_misses",
+            "programs compiled because the persistent cache had no "
+            "loadable entry (then written back)")
+        self._m_evict = reg.counter(
+            "dl4j_compile_cache_evictions",
+            "cache entries removed, by reason: lru (size budget), "
+            "fingerprint (stale runtime quarantined), torn (failed "
+            "CRC — skipped, never loaded), load_error (deserialize "
+            "rejected the payload)")
+        self._m_bytes = reg.gauge(
+            "dl4j_compile_cache_bytes",
+            "bytes held by the persistent compile cache (current "
+            "fingerprint)")
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            self._sweep_stale_fingerprints()
+        except OSError as e:  # unusable dir: behave as always-miss
+            log.warning("compile cache %s unusable: %s", self.root, e)
+        self._m_bytes.set(self._bytes())
+
+    # ------------------------------------------------------- fingerprint
+    def _sweep_stale_fingerprints(self) -> None:
+        """Quarantine-and-delete entries written by a different runtime.
+        They live under a different subdirectory, so they were never
+        loadable from this process to begin with — the sweep just
+        reclaims the bytes and makes the defense visible in metrics."""
+        base = os.path.join(self.root, _LAYOUT)
+        try:
+            names = os.listdir(base)
+        except OSError:
+            return
+        for name in names:
+            if name == self.fingerprint:
+                continue
+            stale = os.path.join(base, name)
+            removed = 0
+            for dirpath, _dirs, files in os.walk(stale, topdown=False):
+                for fn in files:
+                    try:
+                        os.unlink(os.path.join(dirpath, fn))
+                        if fn.endswith(_SUFFIX):
+                            removed += 1
+                    except OSError:
+                        pass
+                try:
+                    os.rmdir(dirpath)
+                except OSError:
+                    pass
+            if removed:
+                self._m_evict.labels(reason="fingerprint").inc(removed)
+                log.info("compile cache: quarantined %d stale entries "
+                         "(fingerprint %s != %s)", removed, name,
+                         self.fingerprint)
+
+    # ------------------------------------------------------------- paths
+    def _path(self, key: str) -> str:
+        return os.path.join(self.dir, key_digest(key) + _SUFFIX)
+
+    def __contains__(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    def keys(self) -> set:
+        """Digests of the entries currently committed (the round-trip
+        tests compare these sets across record/replay processes)."""
+        try:
+            return {fn[:-len(_SUFFIX)] for fn in os.listdir(self.dir)
+                    if fn.endswith(_SUFFIX)}
+        except OSError:
+            return set()
+
+    def _bytes(self) -> int:
+        total = 0
+        try:
+            for fn in os.listdir(self.dir):
+                if fn.endswith(_SUFFIX):
+                    try:
+                        total += os.path.getsize(
+                            os.path.join(self.dir, fn))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        return total
+
+    # --------------------------------------------------------------- put
+    def put(self, key: str, payload: bytes) -> bool:
+        """Commit one serialized program crash-atomically. Returns False
+        (and leaves any previous committed entry intact) on ANY failure
+        — the caller already holds the compiled program, so a failed
+        write costs the NEXT process a compile, nothing more."""
+        path = self._path(key)
+        tmp = path + ".tmp"
+        blob = (_MAGIC
+                + _HEADER.pack(binascii.crc32(payload) & 0xFFFFFFFF,
+                               len(payload))
+                + payload)
+        try:
+            chaos.hit("compile.cache_write", op="write", key=key)
+            os.makedirs(self.dir, exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            chaos.hit("compile.cache_write", op="rename", key=key)
+            os.replace(tmp, path)
+        except BaseException as e:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            log.warning("compile cache write %s failed (%s: %s) — "
+                        "degrading to plain compile next boot",
+                        key_digest(key), type(e).__name__, e)
+            if not isinstance(e, Exception):  # KeyboardInterrupt etc.
+                raise
+            return False
+        self.gc()
+        return True
+
+    # --------------------------------------------------------------- get
+    def get(self, key: str) -> Optional[bytes]:
+        """The committed payload for `key`, or None (missing, torn, or
+        faulted — all of which mean "compile it yourself"). A torn
+        entry is deleted on sight so it cannot keep failing CRC on
+        every boot."""
+        path = self._path(key)
+        try:
+            chaos.hit("compile.cache_read", key=key)
+            with open(path, "rb") as f:
+                blob = f.read()
+        except FileNotFoundError:
+            return None
+        except Exception as e:
+            log.warning("compile cache read %s failed (%s: %s) — "
+                        "compiling instead", key_digest(key),
+                        type(e).__name__, e)
+            return None
+        payload = self._validate(blob)
+        if payload is None:
+            self.invalidate(key, reason="torn")
+            return None
+        try:  # LRU touch: a loaded program is a recently-used program
+            os.utime(path)
+        except OSError:
+            pass
+        return payload
+
+    def _validate(self, blob: bytes) -> Optional[bytes]:
+        if len(blob) < len(_MAGIC) + _HEADER.size:
+            return None
+        if not blob.startswith(_MAGIC):
+            return None
+        crc, length = _HEADER.unpack_from(blob, len(_MAGIC))
+        payload = blob[len(_MAGIC) + _HEADER.size:]
+        if len(payload) != length:
+            return None
+        if binascii.crc32(payload) & 0xFFFFFFFF != crc:
+            return None
+        return payload
+
+    def invalidate(self, key: str, *, reason: str) -> None:
+        """Delete one entry and count the eviction (torn bytes, or a
+        payload `deserialize_and_load` rejected)."""
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+        self._m_evict.labels(reason=reason).inc()
+        log.warning("compile cache entry %s evicted (%s)",
+                    key_digest(key), reason)
+
+    # ---------------------------------------------------------------- gc
+    def gc(self) -> int:
+        """Evict least-recently-used entries until under the byte
+        budget; returns the number evicted. Runs after every put."""
+        try:
+            entries = []
+            for fn in os.listdir(self.dir):
+                if not fn.endswith(_SUFFIX):
+                    continue
+                p = os.path.join(self.dir, fn)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                entries.append((st.st_mtime, st.st_size, p))
+        except OSError:
+            return 0
+        total = sum(size for _, size, _ in entries)
+        evicted = 0
+        if total > self.size_budget_bytes:
+            for _mtime, size, p in sorted(entries):
+                if total <= self.size_budget_bytes:
+                    break
+                try:
+                    os.unlink(p)
+                except OSError:
+                    continue
+                total -= size
+                evicted += 1
+            if evicted:
+                self._m_evict.labels(reason="lru").inc(evicted)
+        self._m_bytes.set(total)
+        return evicted
+
+    # ------------------------------------------------------------- stats
+    def record_hit(self) -> None:
+        self._m_hits.inc()
+
+    def record_miss(self) -> None:
+        self._m_misses.inc()
+
+    def evictions(self) -> Dict[str, int]:
+        return {labels.get("reason", "?"): int(child.value)
+                for labels, child in self._m_evict.children()}
+
+    def stats(self) -> dict:
+        """The /stats "compile_cache" section (process-global counters
+        next to this store's directory identity)."""
+        return {
+            "dir": self.root,
+            "fingerprint": self.fingerprint,
+            "entries": len(self.keys()),
+            "bytes": self._bytes(),
+            "size_budget_bytes": self.size_budget_bytes,
+            "hits": int(self._m_hits.value),
+            "misses": int(self._m_misses.value),
+            "evictions": self.evictions(),
+        }

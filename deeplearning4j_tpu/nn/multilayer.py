@@ -580,7 +580,11 @@ class MultiLayerNetwork:
                 return params, upd_state, gstate, last_scores[-1]
             return params, upd_state, last_scores[-1]
 
-        return epoch
+        from deeplearning4j_tpu import compilecache
+        return compilecache.maybe_wrap(
+            epoch,
+            self._aot_key(f"fit_scan|m={int(masked)}|g={int(guarded)}"),
+            static_argnums=(static,))
 
     def _backprop_fit(self, x, labels, n_valid=None, guard=None) -> None:
         # chaos numeric-fault point (docs/FAULT_TOLERANCE.md): a "nan"
@@ -667,6 +671,21 @@ class MultiLayerNetwork:
             self._params, _ = self._batch_solver.optimize(
                 self._params, *data, rng_key=self.next_key(), sync=False)
 
+    def _aot_key(self, tag: str) -> Optional[str]:
+        """Persistent-compile-cache key for this network's jitted steps
+        (docs/WARMUP.md): the config JSON names the program family, the
+        device binds the serialized executable. None (= stay a plain
+        jit) when no cache is active or the config won't serialize."""
+        from deeplearning4j_tpu import compilecache
+
+        if compilecache.active_compiler() is None:
+            return None
+        try:
+            digest = compilecache.config_digest(self.to_json())
+        except Exception:
+            return None
+        return f"train.{tag}:{digest}|dev={jax.devices()[0]}"
+
     def _get_train_step(self, guarded: bool = False):
         if guarded:
             if self._train_step_guarded is None:
@@ -700,7 +719,8 @@ class MultiLayerNetwork:
                                                 updates)
                 return params, upd_state, score
 
-            return step
+            from deeplearning4j_tpu import compilecache
+            return compilecache.maybe_wrap(step, self._aot_key("step"))
 
         # guarded variant: an all-leaves-finite predicate over grads+loss
         # is reduced on device and the whole update commits through
@@ -719,7 +739,8 @@ class MultiLayerNetwork:
                 params, upd_state, updates, new_state, gstate, score, grads)
             return params, upd_state, gstate, score
 
-        return gstep
+        from deeplearning4j_tpu import compilecache
+        return compilecache.maybe_wrap(gstep, self._aot_key("gstep"))
 
     def train_step_cache_size(self) -> int:
         """Number of XLA programs compiled for the jitted supervised train
@@ -794,8 +815,11 @@ class MultiLayerNetwork:
         before the call (see output), so a ragged request/CSV stream
         compiles <= one program per bucket instead of one per shape."""
         if self._predict_step is None:
-            self._predict_step = jax.jit(
-                lambda params, x: self.feed_forward_fn(params, x)[-1])
+            from deeplearning4j_tpu import compilecache
+            self._predict_step = compilecache.maybe_wrap(
+                jax.jit(
+                    lambda params, x: self.feed_forward_fn(params, x)[-1]),
+                self._aot_key("predict"))
         return self._predict_step
 
     def output(self, x, bucketed: bool = True) -> jnp.ndarray:

@@ -106,6 +106,23 @@ class DataParallelTrainer:
 
         return gstep
 
+    def _aot_key(self, tag: str) -> Optional[str]:
+        """Persistent-compile-cache key (docs/WARMUP.md): config digest
+        + class (subclasses change shardings) + mesh/axis + device set
+        — serialized executables are device- and sharding-bound."""
+        from deeplearning4j_tpu import compilecache
+
+        if compilecache.active_compiler() is None:
+            return None
+        try:
+            digest = compilecache.config_digest(self.network.to_json())
+        except Exception:
+            return None
+        shape = "x".join(str(s) for s in self.mesh.devices.shape)
+        return (f"{type(self).__name__}.{tag}:{digest}|mesh={shape}"
+                f"|axis={self.axis}"
+                f"|dev={jax.devices()[0]}x{self.n_devices}")
+
     def _step_shardings(self):
         """(in_shardings, out_shardings) for (params, upd_state, x,
         labels, rng, n_valid) -> (params, upd_state, score)."""
@@ -117,24 +134,30 @@ class DataParallelTrainer:
         ins, outs = self._step_shardings()
         # donate params/updater state (outputs alias their HBM; fit()
         # rebinds both from the outputs every step)
-        return jax.jit(
-            self._step_fn(),
-            in_shardings=ins,
-            out_shardings=outs,
-            donate_argnums=(0, 1),
-        )
+        from deeplearning4j_tpu import compilecache
+        return compilecache.maybe_wrap(
+            jax.jit(
+                self._step_fn(),
+                in_shardings=ins,
+                out_shardings=outs,
+                donate_argnums=(0, 1),
+            ),
+            self._aot_key("step"))
 
     def _build_guarded_step(self):
         """The guarded step under the subclass's own shardings: the
         GuardianState carry slots in replicated after (params, state)."""
         ins, outs = self._step_shardings()
         rep = replicated(self.mesh)
-        return jax.jit(
-            self._step_fn(guarded=True),
-            in_shardings=(ins[0], ins[1], rep, *ins[2:]),
-            out_shardings=(outs[0], outs[1], rep, outs[2]),
-            donate_argnums=(0, 1),
-        )
+        from deeplearning4j_tpu import compilecache
+        return compilecache.maybe_wrap(
+            jax.jit(
+                self._step_fn(guarded=True),
+                in_shardings=(ins[0], ins[1], rep, *ins[2:]),
+                out_shardings=(outs[0], outs[1], rep, outs[2]),
+                donate_argnums=(0, 1),
+            ),
+            self._aot_key("gstep"))
 
     def pad_batch(self, x: np.ndarray, labels: np.ndarray):
         """Pad the batch to a multiple of the mesh's data-axis size (static

@@ -180,24 +180,32 @@ class ShardedUpdateTrainer(DataParallelTrainer):
                 return body(params, hist, vel, it, x, labels, rng, n_valid,
                             None)
 
-            return jax.jit(
-                step,
-                in_shardings=(rep, shard, shard, rep, bsh, bsh, rep, rep),
-                out_shardings=(rep, shard, shard, rep, rep),
-                donate_argnums=(0, 1, 2),
-            )
+            from deeplearning4j_tpu import compilecache
+            return compilecache.maybe_wrap(
+                jax.jit(
+                    step,
+                    in_shardings=(rep, shard, shard, rep, bsh, bsh, rep,
+                                  rep),
+                    out_shardings=(rep, shard, shard, rep, rep),
+                    donate_argnums=(0, 1, 2),
+                ),
+                self._aot_key("step"))
 
         def gstep(params, hist, vel, it, gstate, x, labels, rng,
                   n_valid=None):
             return body(params, hist, vel, it, x, labels, rng, n_valid,
                         gstate)
 
-        return jax.jit(
-            gstep,
-            in_shardings=(rep, shard, shard, rep, rep, bsh, bsh, rep, rep),
-            out_shardings=(rep, shard, shard, rep, rep, rep),
-            donate_argnums=(0, 1, 2),
-        )
+        from deeplearning4j_tpu import compilecache
+        return compilecache.maybe_wrap(
+            jax.jit(
+                gstep,
+                in_shardings=(rep, shard, shard, rep, rep, bsh, bsh, rep,
+                              rep),
+                out_shardings=(rep, shard, shard, rep, rep, rep),
+                donate_argnums=(0, 1, 2),
+            ),
+            self._aot_key("gstep"))
 
     def _build_guarded_step(self):
         return self._build_step(guarded=True)

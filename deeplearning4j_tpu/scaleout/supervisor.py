@@ -151,6 +151,11 @@ class WorkerSpawner:
         if pkg_root not in path.split(os.pathsep):
             base_env["PYTHONPATH"] = (pkg_root + (os.pathsep + path
                                                   if path else ""))
+        # elastic respawns inherit the AOT program cache: a replacement
+        # worker loads the fleet's train-step executables instead of
+        # recompiling them (docs/WARMUP.md)
+        from deeplearning4j_tpu import compilecache
+        compilecache.export_env(base_env)
         self.env = base_env
         self.env_for = env_for
         self.python = python or sys.executable

@@ -519,6 +519,35 @@ class DecodeLoop:
         # traced, so every CoW fork for the life of the server is ONE
         # program
         self._copy = jax.jit(copy_page, donate_argnums=donate_copy)
+        # persistent compile cache (docs/WARMUP.md): no-op unless the
+        # process activated one. The key pins every closure constant
+        # that changes the program at identical input shapes — model
+        # config, kernel lane, horizon, spec width — plus the device,
+        # because serialized executables are device-bound.
+        from deeplearning4j_tpu import compilecache as _cc
+
+        self.cache_key = (
+            f"decode:{_cc.config_digest(cfg)}|ps={self.page_size}"
+            f"|k={self.decode_kernel}|h={self.horizon}"
+            f"|spec={self.spec_k}|dev={jax.devices()[0]}")
+        self._step = _cc.maybe_wrap(self._step, self.cache_key + "|step")
+        self._verify = _cc.maybe_wrap(self._verify,
+                                      self.cache_key + "|verify")
+        self._prefill = _cc.maybe_wrap(self._prefill,
+                                       self.cache_key + "|prefill")
+        self._prefill_ctx = _cc.maybe_wrap(
+            self._prefill_ctx, self.cache_key + "|prefill_ctx")
+        self._copy = _cc.maybe_wrap(self._copy, self.cache_key + "|copy")
+        #: program-usage record for plan_fragment(): (bb, tb) /
+        #: (bb, cb, tb) prefill groups actually dispatched, plus flags
+        #: for the fixed-shape programs actually run — the plan must
+        #: list exactly the programs a boot like this one compiles, or
+        #: replay would add programs the record run never had
+        self._plan_prefill: set = set()
+        self._plan_prefill_ctx: set = set()
+        self._plan_step = False
+        self._plan_verify = False
+        self._plan_copy = False
 
         # queueing / lifecycle ----------------------------------------
         self._cond = threading.Condition()
@@ -951,6 +980,75 @@ class DecodeLoop:
         ladder (one per bucket hit)."""
         return jit_cache_size(self._prefill)
 
+    # ---- warmup plans (docs/WARMUP.md)
+    def plan_fragment(self) -> dict:
+        """The "decode" fragment of a warmup plan: which of this loop's
+        programs existed and at which prefill group shapes. Fixed-shape
+        programs (step, verify, copy) are flags — their shapes are
+        implied by the loop config; only the prefill groups are
+        traffic-dependent."""
+        frag = {
+            "cache_key": self.cache_key,
+            "step": self._plan_step,
+            "verify": self._plan_verify,
+            "copy": self._plan_copy,
+            "prefill": sorted(list(g) for g in self._plan_prefill),
+            "prefill_ctx": sorted(list(g)
+                                  for g in self._plan_prefill_ctx),
+        }
+        if (self._drafter is not None
+                and getattr(self._drafter, "kind", None) == "model"):
+            frag["draft"] = {"rows": self.slots, "k": self.spec_k}
+        return frag
+
+    def warm_programs(self, frag: dict) -> int:
+        """Replay a recorded plan fragment: AOT load-or-compile every
+        listed program via `jax.ShapeDtypeStruct` placeholders, WITHOUT
+        executing anything (execution would donate buffers and write
+        the page pool). No-op unless this process has the persistent
+        cache active (plain jits can't be preloaded) and the fragment
+        matches this loop's program identity. Returns the number of
+        programs warmed."""
+        import jax
+
+        if frag.get("cache_key") != self.cache_key:
+            return 0
+        if not hasattr(self._step, "warm"):
+            return 0
+
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        def ints(*shape):
+            return jax.ShapeDtypeStruct(shape, np.int32)
+
+        params_spec = jax.tree_util.tree_map(sds, self.params)
+        pool_spec = jax.tree_util.tree_map(sds, self._pool)
+        S, P, ps = self.slots, self._pps, self.page_size
+        n = 0
+        if frag.get("step"):
+            n += self._step.warm(params_spec, ints(S), pool_spec,
+                                 ints(S, P), ints(S), ints(S))
+        if frag.get("verify") and self.spec_k:
+            n += self._verify.warm(params_spec,
+                                   ints(S, self.spec_k + 1), pool_spec,
+                                   ints(S, P), ints(S), ints(S))
+        if frag.get("copy"):
+            n += self._copy.warm(pool_spec, ints(), ints())
+        for bb, tb in frag.get("prefill", ()):
+            n += self._prefill.warm(params_spec, ints(bb, tb), ints(bb),
+                                    pool_spec, ints(bb, tb // ps))
+        for bb, cb, tb in frag.get("prefill_ctx", ()):
+            n += self._prefill_ctx.warm(
+                params_spec, ints(bb, tb), ints(bb), pool_spec,
+                ints(bb, tb // ps), ints(bb, cb), ints(bb))
+        draft = frag.get("draft")
+        if (draft and self._drafter is not None
+                and hasattr(self._drafter, "warm")):
+            n += int(self._drafter.warm(int(draft.get("rows", S)),
+                                        int(draft.get("k", self.spec_k))))
+        return n
+
     def snapshot(self) -> dict:
         with self._cond:
             return {
@@ -1332,6 +1430,7 @@ class DecodeLoop:
                 lens[row] = plen
                 pids[row, :len(pages)] = pages
                 self._prefill_token_count += plen
+            self._plan_prefill.add((bb, tb))
             first, self._pool = self._prefill(
                 self.params, jnp.asarray(padded), jnp.asarray(lens),
                 self._pool, jnp.asarray(pids))
@@ -1367,6 +1466,7 @@ class DecodeLoop:
                 ctab[row, :cp] = pages[:cp]
                 clen[row] = cov
                 self._prefill_token_count += tl
+            self._plan_prefill_ctx.add((bb, cb, tb))
             first, self._pool = self._prefill_ctx(
                 self.params, jnp.asarray(padded), jnp.asarray(lens),
                 self._pool, jnp.asarray(pids), jnp.asarray(ctab),
@@ -1456,6 +1556,7 @@ class DecodeLoop:
                 return max(length, j * ps)
             try:
                 chaos.hit("decode.fork")
+                self._plan_copy = True
                 self._pool = self._copy(
                     self._pool, jnp.asarray(page, jnp.int32),
                     jnp.asarray(new, jnp.int32))
@@ -1507,6 +1608,7 @@ class DecodeLoop:
                 idxs = jnp.asarray([i for _, i in members])
                 self._d_tokens = self._d_tokens.at[idxs].set(arr[rows])
         t0 = time.perf_counter()
+        self._plan_step = True
         toks, t_out, l_out, self._pool = self._step(
             self.params, self._d_tokens, self._pool, self._d_table,
             self._d_lengths, self._d_stop)
@@ -1628,6 +1730,7 @@ class DecodeLoop:
             widths[i] = 1 + n
             self._m_spec_proposed.inc(n)
         t0 = time.perf_counter()
+        self._plan_verify = True
         out, self._pool = self._verify(
             self.params, jnp.asarray(tokens), self._pool,
             jnp.asarray(self._table), jnp.asarray(before),

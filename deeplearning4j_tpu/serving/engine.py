@@ -148,8 +148,11 @@ class InferenceEngine:
                  buckets: Optional[Sequence[int]] = None,
                  min_bucket: int = DEFAULT_MIN_BUCKET,
                  device=None,
-                 generate_fn: Optional[Callable] = None):
+                 generate_fn: Optional[Callable] = None,
+                 cache_key: Optional[str] = None):
         import jax
+
+        from deeplearning4j_tpu import compilecache
 
         if buckets is None:
             buckets = pow2_buckets(max_batch_size, min_bucket=min_bucket)
@@ -165,7 +168,15 @@ class InferenceEngine:
         # reused for activations; CPU ignores donation with a warning,
         # so gate it off there
         donate = () if jax.default_backend() == "cpu" else (1,)
-        self._jit = jax.jit(apply_fn, donate_argnums=donate)
+        #: model identity for the persistent compile cache
+        #: (docs/WARMUP.md); the full program key also pins the device,
+        #: because serialized executables are device-bound — replica 3
+        #: on cpu:3 must not load replica 0's programs
+        dev = device if device is not None else jax.devices()[0]
+        self.cache_key = (f"{cache_key}|dev={dev}"
+                          if cache_key is not None else None)
+        self._jit = compilecache.maybe_wrap(
+            jax.jit(apply_fn, donate_argnums=donate), self.cache_key)
         self._generate_fn = generate_fn
         #: continuous-batching slot scheduler (transformer engines;
         #: start_decode_loop) — None until started
@@ -174,6 +185,12 @@ class InferenceEngine:
         #: True once warmup() precompiled every bucket — the readiness
         #: surface (/readyz, docs/FLEET.md) reads it
         self.warmed_up = False
+        #: wall seconds the last warmup()/warmup_from_plan() took (the
+        #: cold-vs-warm spin-up number /stats and bench.py warmup pin)
+        self.warmup_seconds: Optional[float] = None
+        #: feature shape + dtype the engine warms with, captured from
+        #: warmup() or the first infer() — what plan_fragment() records
+        self._warm_shape: Optional[tuple] = None
         #: checkpoint identity this engine serves ({path, step} or None
         #: for constructor-installed params) — recorded by load_params,
         #: surfaced through /readyz and /stats so the deployment
@@ -191,6 +208,10 @@ class InferenceEngine:
     def for_network(cls, net, **kw) -> "InferenceEngine":
         """Wrap a MultiLayerNetwork: apply = output-layer activations
         (the bucketed twin of `net.output`)."""
+        from deeplearning4j_tpu.compilecache import config_digest
+
+        kw.setdefault("cache_key",
+                      "serve.net:" + config_digest(net.to_json()))
         return cls(lambda p, x: net.feed_forward_fn(p, x)[-1],
                    net.param_table, **kw)
 
@@ -223,9 +244,11 @@ class InferenceEngine:
         the chosen `drafter` flavor ("ngram", or "model" with
         `draft_params`/`draft_cfg` — docs/SERVING.md "Speculative
         decoding")."""
+        from deeplearning4j_tpu.compilecache import config_digest
         from deeplearning4j_tpu.models.transformer import transformer_logits
         from deeplearning4j_tpu.serving.kv_cache import generate_cached
 
+        kw.setdefault("cache_key", "serve.tf:" + config_digest(cfg))
         eng = cls(lambda p, tok: transformer_logits(p, tok, cfg), params,
                   generate_fn=lambda p, prompt, n: generate_cached(
                       p, prompt, cfg, n),
@@ -274,6 +297,9 @@ class InferenceEngine:
         n = int(x.shape[0])
         if n == 0:
             raise ValueError("empty request")
+        if self._warm_shape is None:
+            self._warm_shape = (tuple(int(d) for d in x.shape[1:]),
+                                x.dtype.str)
         start = time.perf_counter()
         try:
             with span("engine_infer", rows=n):
@@ -446,13 +472,64 @@ class InferenceEngine:
         requests don't pay compile latency. `feature_shape` is one
         example's shape (without the batch dim). Bypasses EngineStats —
         warmup compiles must not pollute the serving p50/p99/occupancy
-        the bench and /stats report."""
+        the bench and /stats report.
+
+        With a persistent compile cache active, each bucket's program is
+        loaded from disk instead of compiled when a prior run left it
+        there (the execute below then just runs the loaded program on
+        zeros)."""
         import jax
 
+        start = time.perf_counter()
         for b in self.buckets:
             xb = jax.device_put(np.zeros((b, *feature_shape), dtype),
                                 self.device)
             np.asarray(self._jit(self._params, xb))
+        self.warmup_seconds = time.perf_counter() - start
+        self._warm_shape = (tuple(int(d) for d in feature_shape),
+                            np.dtype(dtype).str)
+        self.warmed_up = True
+
+    # ------------------------------------------------- warmup plans
+    def plan_fragment(self) -> Optional[dict]:
+        """The "engine" fragment of a warmup plan (docs/WARMUP.md):
+        the buckets this engine compiled — ladder plus any pow2 escape
+        buckets traffic actually forwarded — and the feature shape to
+        build them with. None until a shape is known (no warmup and no
+        traffic yet) or when the engine has no cache identity."""
+        if self.cache_key is None or self._warm_shape is None:
+            return None
+        shape, dtype = self._warm_shape
+        buckets = set(self.buckets) | set(self.stats.bucket_forwards())
+        return {"cache_key": self.cache_key,
+                "buckets": sorted(int(b) for b in buckets),
+                "feature_shape": list(shape),
+                "dtype": dtype}
+
+    def warmup_from_plan(self, frag: dict) -> None:
+        """Replay a recorded plan fragment: AOT load-or-compile every
+        bucket program listed, WITHOUT executing anything (pure
+        `lower().compile()` / deserialize via the persistent cache).
+        Falls back to the standard execute-zeros warmup when the engine
+        is not cache-wrapped or the fragment was recorded for a
+        different model identity."""
+        import jax
+
+        shape = tuple(int(d) for d in frag.get("feature_shape", ()))
+        dtype = np.dtype(frag.get("dtype", "float32"))
+        if (frag.get("cache_key") != self.cache_key
+                or not hasattr(self._jit, "warm")):
+            self.warmup(shape, dtype)
+            return
+        start = time.perf_counter()
+        sds = lambda a: jax.ShapeDtypeStruct(  # noqa: E731
+            a.shape, a.dtype)
+        params_spec = jax.tree_util.tree_map(sds, self._params)
+        for b in frag.get("buckets", self.buckets):
+            self._jit.warm(params_spec,
+                           jax.ShapeDtypeStruct((int(b), *shape), dtype))
+        self.warmup_seconds = time.perf_counter() - start
+        self._warm_shape = (shape, dtype.str)
         self.warmed_up = True
 
     def program_cache_size(self) -> int:
@@ -466,6 +543,8 @@ class InferenceEngine:
         snap = self.stats.snapshot()
         snap["buckets"] = list(self.buckets)
         snap["compiled_programs"] = self.program_cache_size()
+        if self.warmup_seconds is not None:
+            snap["warmup_seconds"] = round(self.warmup_seconds, 4)
         snap["checkpoint"] = self.checkpoint
         if self.draft_checkpoint is not None:
             snap["draft_checkpoint"] = self.draft_checkpoint

@@ -321,6 +321,10 @@ class ReplicaSpawner:
         self.host = host
         self.serve_args = list(serve_args)
         self.env = dict(env) if env is not None else dict(os.environ)
+        # replicas inherit the parent's AOT program cache so respawns
+        # and autoscale spin-ups boot warm (docs/WARMUP.md)
+        from deeplearning4j_tpu import compilecache
+        compilecache.export_env(self.env)
         self.python = python or sys.executable
         self.announce_timeout = float(announce_timeout)
 

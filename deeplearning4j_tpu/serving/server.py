@@ -90,6 +90,11 @@ _M_DISCONNECTS = telemetry.counter(
     "dl4j_serve_client_disconnects",
     "streaming clients that hung up mid-/generate (their slots were "
     "cancelled and their KV pages freed)")
+_M_WARMUP_S = telemetry.gauge(
+    "dl4j_compile_warmup_seconds",
+    "wall seconds the serving warmup took (plan replay + bucket "
+    "precompile) — the cold-vs-warm spin-up number docs/WARMUP.md "
+    "tracks")
 
 #: per-request wait on the batcher future — generous; the batcher bounds
 #: queueing at max_delay_ms, so hitting this means the engine died.
@@ -121,6 +126,16 @@ class ServingHandle:
         self.warmup_error: Optional[str] = None
         if not warmup_pending:
             self._warmed.set()
+        # AOT warm-start state (docs/WARMUP.md): the plan loaded at
+        # boot (None = cold), where to record this process's own
+        # program set, and the post-warmup baselines that define
+        # `recompiled_after_warmup`
+        self.warmup_plan: Optional[dict] = None
+        self.warmup_plan_path: Optional[str] = None
+        self.warmup_seconds: Optional[float] = None
+        self.plan_replay: Optional[dict] = None
+        self._baseline_misses: Optional[int] = None
+        self._baseline_programs: Optional[int] = None
 
     @property
     def url(self) -> str:
@@ -132,12 +147,76 @@ class ServingHandle:
 
     def close(self) -> None:
         """Stop accepting requests, flush the batcher, drain the decode
-        loop, release the socket."""
+        loop, release the socket. Re-records the warmup plan on the way
+        out — the plan now includes every program TRAFFIC compiled
+        (escape buckets, prefill groups), so the next boot warms the
+        real working set, not just what warmup touched."""
         self.http.close()
         if self.batcher is not None:
             self.batcher.close()
         if self.generate_engine is not None:
             self.generate_engine.close()  # drains the decode loop
+        self.record_plan()
+
+    # ----------------------------------------------- warmup plans
+    def build_plan(self) -> dict:
+        """The warmup plan describing this process's compiled program
+        set (docs/WARMUP.md): one fragment per predict engine (matched
+        at replay by cache_key — replicas pin different devices) plus
+        the decode loop's."""
+        plan: dict = {"engines": [], "decode": None}
+        for eng in self.replicas.engines:
+            # getattr: engine-shaped wrappers without a plan surface
+            frag = getattr(eng, "plan_fragment", lambda: None)()
+            if frag is not None:
+                plan["engines"].append(frag)
+        ge = self.generate_engine
+        if ge is not None and ge.decode_loop is not None:
+            plan["decode"] = ge.decode_loop.plan_fragment()
+        return plan
+
+    def record_plan(self) -> bool:
+        """Write the current program set to `warmup_plan_path`
+        (crash-atomic; no-op without a path)."""
+        from deeplearning4j_tpu.compilecache import warmup as _warmup
+
+        if self.warmup_plan_path is None:
+            return False
+        return _warmup.save_plan(self.warmup_plan_path,
+                                 self.build_plan())
+
+    def _program_total(self) -> int:
+        """Every compiled-program counter this process exposes, summed
+        — the cache-less definition of `recompiled_after_warmup`."""
+        total = 0
+        for eng in self.replicas.engines:
+            total += max(0, eng.program_cache_size())
+        ge = self.generate_engine
+        if ge is not None:
+            total += max(0, ge.program_cache_size())
+            loop = ge.decode_loop
+            if loop is not None:
+                snap_keys = (loop.decode_step_programs(),
+                             loop.prefill_programs())
+                total += sum(max(0, n) for n in snap_keys)
+        return total
+
+    def recompiled_after_warmup(self) -> Optional[int]:
+        """Programs compiled AFTER warmup finished: store misses since
+        the post-warmup baseline when the persistent cache is active
+        (a miss is exactly a compile), program-count growth otherwise.
+        None until warmup has run. Zero on a warm boot is the whole
+        point of the subsystem — bench.py warmup gates on it."""
+        from deeplearning4j_tpu import compilecache
+
+        comp = compilecache.active_compiler()
+        if comp is not None and self._baseline_misses is not None:
+            return int(comp.store.stats()["misses"]
+                       - self._baseline_misses)
+        if self._baseline_programs is not None:
+            return max(0, self._program_total()
+                       - self._baseline_programs)
+        return None
 
     def __enter__(self) -> "ServingHandle":
         return self
@@ -147,15 +226,50 @@ class ServingHandle:
 
     # ----------------------------------------------------- readiness
     def _run_warmup(self, feature_shape) -> None:
-        """Background warmup (`warmup_async=True`): the socket is
-        already accepting — /healthz answers, /readyz gates admission
-        until every bucket program is compiled."""
+        """Warmup (sync before the socket opens, or on a background
+        thread with `warmup_async=True` — /healthz answers, /readyz
+        gates admission until this lands). With a loaded warmup plan,
+        each engine/decode-loop replays its recorded fragment (AOT
+        load from the persistent cache, no execution); engines without
+        a matching fragment — and every engine on a cold boot — run
+        the standard execute-every-bucket warmup. Afterwards the
+        post-warmup baselines are pinned (recompiled_after_warmup
+        counts from here) and the plan is recorded for the next
+        boot."""
+        from deeplearning4j_tpu import compilecache
+
+        start = time.perf_counter()
         try:
-            self.replicas.warmup(tuple(feature_shape))
+            plan = self.warmup_plan
+            frags = {f.get("cache_key"): f
+                     for f in (plan or {}).get("engines", [])}
+            replayed = {"engines": 0, "decode": 0}
+            for eng in self.replicas.engines:
+                frag = frags.get(getattr(eng, "cache_key", None))
+                if frag is not None:
+                    eng.warmup_from_plan(frag)
+                    replayed["engines"] += 1
+                elif feature_shape is not None:
+                    eng.warmup(tuple(feature_shape))
+            loop = (self.generate_engine.decode_loop
+                    if self.generate_engine is not None else None)
+            dfrag = (plan or {}).get("decode")
+            if loop is not None and dfrag:
+                replayed["decode"] = loop.warm_programs(dfrag)
+            if plan is not None:
+                self.plan_replay = replayed
         except Exception as e:  # surface via /readyz, don't die silent
             self.warmup_error = f"{type(e).__name__}: {e}"
         finally:
+            self.warmup_seconds = time.perf_counter() - start
+            _M_WARMUP_S.set(self.warmup_seconds)
+            comp = compilecache.active_compiler()
+            if comp is not None:
+                self._baseline_misses = int(
+                    comp.store.stats()["misses"])
+            self._baseline_programs = self._program_total()
             self._warmed.set()
+        self.record_plan()
 
     def readiness(self) -> dict:
         """Readiness probe payload: ready iff warmup precompile is done
@@ -183,11 +297,15 @@ class ServingHandle:
                "checkpoint": self.replicas.checkpoint}
         if loop is not None:
             out["decode_loop_alive"] = loop.alive
+        if self.warmup_seconds is not None:
+            out["warmup_seconds"] = round(self.warmup_seconds, 4)
         if reasons:
             out["reason"] = "; ".join(reasons)
         return out
 
     def stats(self) -> dict:
+        from deeplearning4j_tpu import compilecache
+
         out = {"uptime_s": round(time.time() - self.started_at, 3),
                "checkpoint": self.replicas.checkpoint,
                "replicas": self.replicas.snapshot()}
@@ -197,6 +315,16 @@ class ServingHandle:
             out["generate"] = self.generate_engine.snapshot()
         if self.last_reload is not None:
             out["last_reload"] = self.last_reload
+        if self.warmup_seconds is not None:
+            out["warmup"] = {
+                "seconds": round(self.warmup_seconds, 4),
+                "plan_replayed": self.plan_replay,
+                "recompiled_after_warmup":
+                    self.recompiled_after_warmup(),
+            }
+        cache_stats = compilecache.stats()
+        if cache_stats is not None:
+            out["compile_cache"] = cache_stats
         return out
 
     def load_checkpoint(self, path: str, step: Optional[int] = None) -> dict:
@@ -263,7 +391,9 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                   host: str = "127.0.0.1", port: int = 0,
                   warmup_shape=None,
                   warmup_async: bool = False,
-                  checkpoint: Optional[dict] = None) -> ServingHandle:
+                  checkpoint: Optional[dict] = None,
+                  compile_cache: Optional[str] = None,
+                  warmup_plan: Optional[str] = "auto") -> ServingHandle:
     """Serve a MultiLayerNetwork (or a prebuilt ReplicaSet) over HTTP.
 
     Pass `net` for the common case — a replica set is built across
@@ -300,7 +430,24 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
     lower water marks, admitted behind interactive, preemptible —
     and `batch_share` tunes its weighted-fair slice of the decode
     slots (docs/SERVING.md "Priority tiers").
+
+    AOT warm-start (docs/WARMUP.md): `compile_cache=DIR` activates the
+    persistent program cache for this process (pass engines built
+    AFTER activation — or activate via `compilecache.activate` /
+    `DL4J_TPU_COMPILE_CACHE` before constructing them — so their jits
+    are cache-wrapped). `warmup_plan` replays a recorded program set
+    at warmup time: "auto" (default) looks for a plan co-located in
+    the active cache dir and is a silent no-op when there is none or
+    no cache is active; "off" disables replay; any other value is a
+    plan file path. The handle re-records the plan after warmup and at
+    close, so a replica's next boot warms exactly the program set this
+    one actually used.
     """
+    from deeplearning4j_tpu import compilecache
+    from deeplearning4j_tpu.compilecache import warmup as _warmup_mod
+
+    if compile_cache:
+        compilecache.activate(compile_cache)
     if replicas is None:
         if net is None:
             raise ValueError("serve_network needs net= or replicas=")
@@ -313,8 +460,6 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
         for _e in replicas.engines:
             _e.checkpoint = dict(checkpoint)
     warm = tuple(warmup_shape) if warmup_shape is not None else None
-    if warm is not None and not warmup_async:
-        replicas.warmup(warm)
     # slots=0 opts out of continuous batching: /generate falls back to
     # the per-request compiled-scan path (no streaming/EOS)
     if (generate_engine is not None and slots
@@ -334,9 +479,34 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
     batcher = replicas.batcher(max_batch_size=max_batch_size,
                                max_delay_ms=max_delay_ms,
                                max_queue=max_queue)
+    # resolve the warmup plan (docs/WARMUP.md): "auto" keys the plan
+    # off the first cache-identified engine, inside the active cache
+    # dir — record and replay coordinate through the directory alone
+    plan_path = plan_doc = None
+    if warmup_plan and warmup_plan != "off":
+        if warmup_plan == "auto":
+            cache_dir = compilecache.active_dir()
+            # getattr: callers may hand in engine-shaped wrappers
+            # (test fixtures, gating shims) without a cache identity
+            identity = next(
+                (getattr(e, "cache_key", None)
+                 for e in ([generate_engine] if generate_engine else [])
+                 + list(replicas.engines)
+                 if getattr(e, "cache_key", None) is not None), None)
+            if cache_dir and identity:
+                plan_path = _warmup_mod.auto_plan_path(cache_dir,
+                                                       identity)
+        else:
+            plan_path = warmup_plan
+        if plan_path:
+            plan_doc = _warmup_mod.load_plan(plan_path)
+    run_warmup = (warm is not None or plan_doc is not None)
     handle = ServingHandle(replicas, batcher, generate_engine,
-                           warmup_pending=(warm is not None
-                                           and warmup_async))
+                           warmup_pending=(run_warmup and warmup_async))
+    handle.warmup_plan = plan_doc
+    handle.warmup_plan_path = plan_path
+    if run_warmup and not warmup_async:
+        handle._run_warmup(warm)
 
     class Handler(BaseHTTPRequestHandler):
         # chunked transfer (the streaming /generate response) needs
@@ -765,7 +935,7 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                    "finish_reasons": [s.finish_reason for s in streams]})
 
     handle.http = start_http_server(Handler, host=host, port=port)
-    if warm is not None and warmup_async:
+    if run_warmup and warmup_async:
         threading.Thread(target=handle._run_warmup, args=(warm,),
                          daemon=True, name="serve-warmup").start()
     return handle

@@ -154,7 +154,31 @@ class ModelDrafter:
             _, toks = jax.lax.scan(step, window, None, length=k)
             return toks.T  # (S, k)
 
-        self._draft = jax.jit(draft_fn, static_argnums=2)
+        from deeplearning4j_tpu import compilecache
+
+        self._draft = compilecache.maybe_wrap(
+            jax.jit(draft_fn, static_argnums=2),
+            f"draft:{compilecache.config_digest(cfg)}"
+            f"|w={self.window}|dev={jax.devices()[0]}",
+            static_argnums=(2,))
+
+    def warm(self, rows: int, k: int) -> bool:
+        """AOT load-or-compile the `(rows, window)` draft-scan program
+        via the persistent compile cache, without executing it (warmup
+        plan replay — docs/WARMUP.md). False when no cache is active or
+        the program had to stay lazy."""
+        import jax
+
+        if self._draft is None:
+            self._build()
+        if not hasattr(self._draft, "warm"):
+            return False
+        sds = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            self.params)
+        return self._draft.warm(
+            sds, jax.ShapeDtypeStruct((int(rows), self.window),
+                                      np.int32), int(k))
 
     def propose_all(self, windows: np.ndarray, k: int) -> np.ndarray:
         """(S, window) int32 right-aligned histories -> (S, k) int32

@@ -116,6 +116,19 @@ POINTS = {
     "fleet.journal": "serving control-plane journal (the fleet/router "
                      "twin of supervisor.journal; same write/rename "
                      "ordinals and atomicity contract)",
+    "compile.cache_write": "persistent AOT program store "
+                           "(compilecache/store.py), fired with "
+                           "op=write before the tmp entry write and "
+                           "op=rename before the commit rename — an "
+                           "error at ANY ordinal loses only that cache "
+                           "entry (the process keeps its compiled "
+                           "program; the next boot recompiles), and a "
+                           "torn write is CRC-quarantined, never "
+                           "loaded (docs/WARMUP.md)",
+    "compile.cache_read": "persistent AOT program store, before each "
+                          "entry read at load time — an error degrades "
+                          "that program to a plain cold compile, "
+                          "never a serve/train failure",
     "pipeline.watch": "deployment controller's checkpoint-directory "
                       "scan, before each poll's committed-step listing "
                       "(errors = an unreadable checkpoint root the "

@@ -80,6 +80,13 @@ def pytest_configure(config):
         "tiers\"); the in-process drills run in tier-1, the "
         "SIGKILL-mid-preemption process drill also carries @slow — "
         "run the whole layer with pytest -m slo")
+    config.addinivalue_line(
+        "markers",
+        "aot: AOT warm-start lane (compilecache: persistent program "
+        "store, warmup plans, chaos-faulted cache drills — "
+        "docs/WARMUP.md); the in-process drills run in tier-1, the "
+        "fresh-subprocess replay drill also carries @slow — run the "
+        "whole layer with pytest -m aot")
 
 
 def pytest_collection_modifyitems(config, items):

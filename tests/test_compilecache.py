@@ -1,0 +1,576 @@
+"""AOT warm-start: persistent program cache + warmup plans (ISSUE 18
+acceptance).
+
+The contracts under test (compilecache/, docs/WARMUP.md):
+
+1. **Store durability**: crash-atomic entry commit — a torn or
+   CRC-failing entry is skipped and quarantined, NEVER loaded; chaos
+   faults at `compile.cache_write`/`compile.cache_read` at any ordinal
+   degrade to plain compilation with correct outputs, never an error.
+2. **Stale-runtime defense**: entries under a different runtime
+   fingerprint are swept on open
+   (`dl4j_compile_cache_evictions{reason="fingerprint"}`); the LRU
+   byte budget evicts oldest-read entries (`reason="lru"`).
+3. **Dispatch equivalence**: `AotDispatch` is a drop-in for the jit it
+   wraps — identical outputs cold, warm, faulted, and with static
+   argnums — and `jit_cache_size` keeps counting programs through it.
+4. **Warmup-plan round trip**: the program set one engine/decode-loop
+   compiled, recorded as a plan, replays on a fresh instance to the
+   IDENTICAL store key set — across kernel lane x speculation x prefix
+   cache — after which traffic recompiles nothing and produces
+   bit-identical tokens.
+5. **Spin-up integration**: `serve_network(compile_cache=...)` boots
+   warm from the recorded plan (`recompiled_after_warmup == 0`), and
+   /stats + /metrics surface `dl4j_compile_*`; spawners export
+   `DL4J_TPU_COMPILE_CACHE` to children.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import compilecache as cc
+from deeplearning4j_tpu.compilecache import warmup as ccwarmup
+from deeplearning4j_tpu.compilecache.store import ProgramStore, key_digest
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   init_transformer_params)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
+from deeplearning4j_tpu.serving.engine import InferenceEngine
+from deeplearning4j_tpu.serving.server import serve_network
+from deeplearning4j_tpu.testing import chaos
+from deeplearning4j_tpu.testing.chaos import Rule
+from deeplearning4j_tpu.utils.jitcache import jit_cache_size
+
+pytestmark = pytest.mark.aot
+
+CFG = TransformerConfig(vocab_size=17, d_model=16, n_heads=2, n_layers=1,
+                        d_ff=32, max_len=64)
+
+
+def _params(seed=0, cfg=CFG):
+    return init_transformer_params(jax.random.PRNGKey(seed), cfg)
+
+
+def _net(n_in=4, n_out=3):
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(n_in).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=n_out)
+            .pretrain(False).build())
+    return MultiLayerNetwork(conf)
+
+
+@pytest.fixture(autouse=True)
+def _clean_cache_state():
+    """Every test runs with NO process-global compiler and no env
+    export leaking in or out (activation is explicit per test)."""
+    cc.deactivate()
+    chaos.deactivate()
+    yield
+    chaos.deactivate()
+    cc.deactivate()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read().decode())
+
+
+def _post(url, obj):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read().decode())
+
+
+# ---------------------------------------------------------------- store
+class TestProgramStore:
+    def test_put_get_roundtrip(self, tmp_path):
+        st = ProgramStore(str(tmp_path))
+        assert st.get("k") is None
+        assert st.put("k", b"payload-bytes")
+        assert st.get("k") == b"payload-bytes"
+        assert "k" in st
+        assert st.keys() == {key_digest("k")}
+        # overwrite commits atomically over the old entry
+        assert st.put("k", b"v2")
+        assert st.get("k") == b"v2"
+        assert st.stats()["entries"] == 1
+
+    def test_torn_entry_skipped_and_quarantined(self, tmp_path):
+        st = ProgramStore(str(tmp_path))
+        st.put("k", b"x" * 256)
+        path = os.path.join(st.dir, key_digest("k") + ".xc")
+        blob = open(path, "rb").read()
+        before = st.evictions().get("torn", 0)
+        # torn tail (truncated rename target copied externally)
+        open(path, "wb").write(blob[:len(blob) // 2])
+        assert st.get("k") is None
+        assert not os.path.exists(path)  # deleted on sight
+        assert st.evictions().get("torn", 0) == before + 1
+
+    def test_crc_mismatch_skipped(self, tmp_path):
+        st = ProgramStore(str(tmp_path))
+        st.put("k", b"y" * 128)
+        path = os.path.join(st.dir, key_digest("k") + ".xc")
+        blob = bytearray(open(path, "rb").read())
+        blob[-1] ^= 0xFF  # flip one payload byte; header CRC now lies
+        open(path, "wb").write(bytes(blob))
+        assert st.get("k") is None
+        assert not os.path.exists(path)
+
+    def test_lru_gc_size_budget(self, tmp_path):
+        st = ProgramStore(str(tmp_path), size_budget_bytes=600)
+        for i in range(5):
+            st.put(f"k{i}", bytes([i]) * 180)  # ~200B/entry with header
+            os.utime(os.path.join(st.dir, key_digest(f"k{i}") + ".xc"),
+                     (i, i))  # deterministic LRU order
+        st.gc()
+        assert st.stats()["bytes"] <= 600
+        assert st.evictions().get("lru", 0) >= 2
+        # newest-touched entries survive
+        assert st.get("k4") is not None
+        assert st.get("k0") is None
+
+    def test_fingerprint_quarantine(self, tmp_path):
+        old = ProgramStore(str(tmp_path), fingerprint="deadbeef00000000")
+        old.put("k", b"stale-runtime-program")
+        new = ProgramStore(str(tmp_path), fingerprint="cafebabe00000000")
+        # the stale subtree is gone, counted, and was never loadable
+        assert new.get("k") is None
+        assert not os.path.isdir(old.dir)
+        assert new.evictions().get("fingerprint", 0) >= 1
+
+    def test_chaos_write_fault_degrades(self, tmp_path):
+        st = ProgramStore(str(tmp_path))
+        for op_ordinal in (0, 1):  # fault the tmp write, then the rename
+            chaos.configure([Rule("compile.cache_write", "error",
+                                  at=[op_ordinal])])
+            try:
+                assert st.put("k", b"data") is False
+            finally:
+                chaos.deactivate()
+            assert st.get("k") is None      # nothing torn committed
+            assert st.keys() == set()
+        # and with chaos gone the same put commits
+        assert st.put("k", b"data")
+        assert st.get("k") == b"data"
+
+    def test_chaos_read_fault_degrades(self, tmp_path):
+        st = ProgramStore(str(tmp_path))
+        st.put("k", b"data")
+        chaos.configure([Rule("compile.cache_read", "error", times=1)])
+        try:
+            assert st.get("k") is None  # degraded, not raised
+        finally:
+            chaos.deactivate()
+        assert st.get("k") == b"data"   # entry intact afterwards
+
+
+# ------------------------------------------------------------- dispatch
+class TestAotDispatch:
+    def test_miss_then_hit_identical_outputs(self, tmp_path):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        cc.activate(str(tmp_path))
+
+        def build():
+            return cc.maybe_wrap(jax.jit(lambda a: a * 2.0 + 1.0),
+                                 "test.f")
+
+        f1 = build()
+        ref = np.asarray(f1(x))
+        s = cc.stats()
+        assert s["misses"] >= 1 and s["entries"] == 1
+        hits0 = s["hits"]
+        f2 = build()  # fresh dispatcher, same store: loads, no compile
+        out = np.asarray(f2(x))
+        assert (out == ref).all()
+        assert cc.stats()["hits"] == hits0 + 1
+        assert f2.aot_programs() == 1
+        # every program-count pin in the tree keeps working through it
+        assert jit_cache_size(f2) == 1
+
+    def test_static_argnums_roundtrip(self, tmp_path):
+        x = np.ones((2, 3), np.float32)
+        cc.activate(str(tmp_path))
+        f = cc.maybe_wrap(jax.jit(lambda a, k: a * k, static_argnums=1),
+                          "test.static", static_argnums=(1,))
+        assert np.allclose(f(x, 2), x * 2)
+        assert np.allclose(f(x, 5), x * 5)   # distinct static => program
+        assert f.aot_programs() == 2
+        g = cc.maybe_wrap(jax.jit(lambda a, k: a * k, static_argnums=1),
+                          "test.static", static_argnums=(1,))
+        assert np.allclose(g(x, 5), x * 5)   # loaded, statics stripped
+        assert np.allclose(g(x, 2), x * 2)
+
+    def test_warm_via_shape_structs(self, tmp_path):
+        cc.activate(str(tmp_path))
+        f = cc.maybe_wrap(jax.jit(lambda a: a - 1.0), "test.warm")
+        sds = jax.ShapeDtypeStruct((4, 2), np.float32)
+        assert f.warm(sds)            # compiled + persisted, not run
+        assert f.aot_programs() == 1
+        misses = cc.stats()["misses"]
+        x = np.zeros((4, 2), np.float32)
+        assert (np.asarray(f(x)) == -1.0).all()
+        assert cc.stats()["misses"] == misses  # call hit the warm program
+
+    def test_chaos_faults_never_change_results(self, tmp_path):
+        """Fault the cache at EVERY ordinal of a cold+warm cycle: the
+        wrapped function must always return the right answer."""
+        x = np.full((2, 2), 3.0, np.float32)
+        for rules in ([Rule("compile.cache_write", "error")],
+                      [Rule("compile.cache_read", "error")],
+                      [Rule("compile.cache_write", "error"),
+                       Rule("compile.cache_read", "error")]):
+            root = str(tmp_path / f"r{len(rules)}{rules[0].point[-5:]}")
+            cc.activate(root)
+            chaos.configure(rules)
+            try:
+                f = cc.maybe_wrap(jax.jit(lambda a: a * a), "test.chaos")
+                assert (np.asarray(f(x)) == 9.0).all()
+                f2 = cc.maybe_wrap(jax.jit(lambda a: a * a),
+                                   "test.chaos")
+                assert (np.asarray(f2(x)) == 9.0).all()
+            finally:
+                chaos.deactivate()
+                cc.deactivate()
+
+    def test_inactive_cache_is_identity(self):
+        jf = jax.jit(lambda a: a)
+        assert cc.maybe_wrap(jf, "k") is jf       # no compiler active
+        cc_env = os.environ.pop(cc.CACHE_ENV, None)
+        assert cc_env is None or True
+        assert cc.maybe_wrap(jf, None) is jf      # no key => identity
+
+
+# ----------------------------------------------------------- plan files
+class TestWarmupPlans:
+    def test_save_load_roundtrip(self, tmp_path):
+        p = str(tmp_path / "plan.json")
+        assert ccwarmup.save_plan(p, {"engines": [{"cache_key": "e"}],
+                                      "decode": None})
+        doc = ccwarmup.load_plan(p)
+        assert doc["engines"] == [{"cache_key": "e"}]
+        assert doc["version"] == ccwarmup.PLAN_VERSION
+
+    def test_wrong_fingerprint_ignored(self, tmp_path):
+        p = str(tmp_path / "plan.json")
+        ccwarmup.save_plan(p, {"engines": [], "fingerprint": "not-this"})
+        assert ccwarmup.load_plan(p) is None
+
+    def test_torn_and_wrong_version_ignored(self, tmp_path):
+        p = str(tmp_path / "plan.json")
+        open(p, "w").write('{"version": 1, "eng')  # torn JSON
+        assert ccwarmup.load_plan(p) is None
+        ccwarmup.save_plan(p, {"engines": [], "version": 99})
+        assert ccwarmup.load_plan(p) is None
+        assert ccwarmup.load_plan(str(tmp_path / "missing.json")) is None
+
+    def test_replay_plan_matches_by_cache_key(self):
+        calls = []
+
+        class Obj:
+            def __init__(self, key):
+                self.cache_key = key
+
+            def warmup_from_plan(self, frag):
+                calls.append(("eng", frag["cache_key"]))
+
+            def warm_programs(self, frag):
+                calls.append(("loop", frag["cache_key"]))
+                return 1
+
+        plan = {"engines": [{"cache_key": "A"}, {"cache_key": "B"}],
+                "decode": {"cache_key": "D"}}
+        rep = ccwarmup.replay_plan(plan,
+                                   engines=[Obj("A"), Obj("C")],
+                                   loops=[Obj("D")])
+        assert rep == {"engines": 1, "loops": 1, "errors": 0}
+        assert calls == [("eng", "A"), ("loop", "D")]
+
+
+# ----------------------------------------------------- engine round trip
+class TestEngineWarmBoot:
+    def test_record_replay_no_recompiles(self, tmp_path):
+        x = np.random.RandomState(0).rand(5, 4).astype(np.float32)
+        net = _net()
+        cc.activate(str(tmp_path))
+        eng = InferenceEngine.for_network(net)
+        eng.warmup((4,))
+        ref = eng.infer(x)
+        frag = eng.plan_fragment()
+        assert frag["cache_key"] == eng.cache_key
+        assert frag["buckets"]  # the warmed ladder
+        disk = {key_digest(k) for k in eng._jit.store_keys()}
+        assert disk <= ProgramStore(str(tmp_path)).keys()
+
+        cc.deactivate()
+        cc.activate(str(tmp_path))
+        eng2 = InferenceEngine.for_network(_net())
+        eng2.warmup_from_plan(frag)
+        # identical program-set: replay loaded exactly what was recorded
+        assert {key_digest(k) for k in eng2._jit.store_keys()} == disk
+        misses = cc.stats()["misses"]
+        out = eng2.infer(x)
+        assert cc.stats()["misses"] == misses  # zero traffic recompiles
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+# ----------------------------------------------------- decode round trip
+class TestDecodeRoundTrip:
+    PROMPTS = ([1, 2, 3, 4, 5, 6], [7, 8, 9])
+    MT = (10, 8)
+
+    def _traffic(self, loop):
+        streams = loop.submit_many(list(self.PROMPTS), list(self.MT))
+        return [s.result(timeout=120) for s in streams]
+
+    def _dispatchers(self, loop):
+        return [d for d in (loop._step, loop._verify, loop._prefill,
+                            loop._prefill_ctx, loop._copy)
+                if hasattr(d, "store_keys")]
+
+    @pytest.mark.parametrize("kernel", ["auto", "gather"])
+    @pytest.mark.parametrize("spec", [0, 2])
+    @pytest.mark.parametrize("prefix", [True, False])
+    def test_plan_round_trip_identical_keys(self, tmp_path, kernel,
+                                            spec, prefix):
+        params = _params()
+        root = str(tmp_path)
+        cc.activate(root)
+        with DecodeLoop(params, CFG, slots=2, page_size=8,
+                        kernel=kernel, speculation=spec,
+                        prefix_cache=prefix) as loop:
+            ref = self._traffic(loop)
+            frag = loop.plan_fragment()
+            progs = loop.decode_step_programs()
+            keys = set()
+            for d in self._dispatchers(loop):
+                keys |= {key_digest(k) for k in d.store_keys()}
+        assert frag["cache_key"].startswith("decode:")
+        # speculation routes every round through verify; otherwise the
+        # plain step must have dispatched — the flags track actual USE
+        assert frag["verify"] if spec else frag["step"]
+        assert bool(frag["prefill"])
+
+        cc.deactivate()
+        cc.activate(root)
+        with DecodeLoop(params, CFG, slots=2, page_size=8,
+                        kernel=kernel, speculation=spec,
+                        prefix_cache=prefix) as loop2:
+            n = loop2.warm_programs(frag)
+            assert n >= 1
+            keys2 = set()
+            for d in self._dispatchers(loop2):
+                keys2 |= {key_digest(k) for k in d.store_keys()}
+            # the recorded and replayed program-cache key sets match
+            assert keys2 == keys
+            assert loop2.decode_step_programs() == progs
+            misses = cc.stats()["misses"]
+            out = self._traffic(loop2)
+            assert out == ref                       # bit-identical
+            assert cc.stats()["misses"] == misses   # zero recompiles
+
+
+# ------------------------------------------------------ serving handle
+class TestServeWarmStart:
+    def test_cold_then_warm_boot_http(self, tmp_path):
+        root = str(tmp_path / "cache")
+        x = np.random.RandomState(0).rand(3, 4)
+        cold = serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                             warmup_shape=(4,), compile_cache=root)
+        try:
+            ref = _post(f"{cold.url}/predict", {"inputs": x.tolist()})
+            stats = _get(f"{cold.url}/stats")
+            assert stats["warmup"]["recompiled_after_warmup"] == 0
+            assert stats["compile_cache"]["dir"] == os.path.abspath(root)
+            assert stats["compile_cache"]["misses"] >= 1
+            ready = _get(f"{cold.url}/readyz")
+            assert ready["warmup_seconds"] > 0
+            plan_path = cold.warmup_plan_path
+        finally:
+            cold.close()   # records the plan
+            cc.deactivate()
+
+        assert os.path.exists(plan_path)
+        warm = serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                             warmup_shape=(4,), compile_cache=root)
+        try:
+            stats = _get(f"{warm.url}/stats")
+            assert stats["warmup"]["plan_replayed"]["engines"] >= 1
+            assert stats["warmup"]["recompiled_after_warmup"] == 0
+            assert stats["compile_cache"]["hits"] >= 1
+            out = _post(f"{warm.url}/predict", {"inputs": x.tolist()})
+            np.testing.assert_allclose(out["outputs"], ref["outputs"],
+                                       atol=1e-6)
+            # metrics surface: the dl4j_compile_* catalogue is live
+            with urllib.request.urlopen(f"{warm.url}/metrics",
+                                        timeout=30) as r:
+                text = r.read().decode()
+            for series in ("dl4j_compile_cache_hits",
+                           "dl4j_compile_cache_misses",
+                           "dl4j_compile_warmup_seconds"):
+                assert series in text
+            stats = _get(f"{warm.url}/stats")
+            assert stats["warmup"]["recompiled_after_warmup"] == 0
+        finally:
+            warm.close()
+
+    def test_chaos_faulted_cache_serves_clean(self, tmp_path):
+        """A chaos-faulted cache degrades to cold compiles — requests
+        still return 200 with correct outputs, zero errors."""
+        root = str(tmp_path / "cache")
+        x = np.random.RandomState(1).rand(2, 4)
+        chaos.configure([Rule("compile.cache_read", "error"),
+                         Rule("compile.cache_write", "error")])
+        try:
+            with serve_network(_net(), n_replicas=1, max_delay_ms=1.0,
+                               warmup_shape=(4,),
+                               compile_cache=root) as handle:
+                out = _post(f"{handle.url}/predict",
+                            {"inputs": x.tolist()})
+                assert np.asarray(out["outputs"]).shape == (2, 3)
+                assert _get(f"{handle.url}/readyz")["ready"]
+        finally:
+            chaos.deactivate()
+
+
+# ------------------------------------------------------------- spawners
+class TestSpawnerPropagation:
+    def test_replica_spawner_exports_cache_env(self, tmp_path):
+        from deeplearning4j_tpu.serving.fleet import ReplicaSpawner
+
+        cc.activate(str(tmp_path))
+        sp = ReplicaSpawner("model.json")
+        assert sp.env[cc.CACHE_ENV] == str(tmp_path)
+        # an explicit caller-provided value is never overridden
+        sp2 = ReplicaSpawner("model.json",
+                            env={cc.CACHE_ENV: "/elsewhere"})
+        assert sp2.env[cc.CACHE_ENV] == "/elsewhere"
+
+    def test_worker_spawner_exports_cache_env(self, tmp_path):
+        from deeplearning4j_tpu.scaleout.supervisor import WorkerSpawner
+
+        cc.activate(str(tmp_path))
+        sp = WorkerSpawner("reg", "run")
+        assert sp.env[cc.CACHE_ENV] == str(tmp_path)
+
+    def test_no_export_when_inactive(self):
+        from deeplearning4j_tpu.serving.fleet import ReplicaSpawner
+
+        sp = ReplicaSpawner("model.json", env={})
+        assert cc.CACHE_ENV not in sp.env
+
+    def test_env_auto_activation(self, tmp_path):
+        """Children activate lazily from the env var their parent
+        exported — the no-flag inheritance path."""
+        os.environ[cc.CACHE_ENV] = str(tmp_path)
+        try:
+            cc._env_checked = False  # simulate a fresh child process
+            assert cc.active_dir() == str(tmp_path)
+        finally:
+            os.environ.pop(cc.CACHE_ENV, None)
+
+
+# -------------------------------------------------- kill→respawn drill
+@pytest.mark.slow
+class TestFleetRespawnDrill:
+    def test_kill_respawn_boots_warm(self, tmp_path):
+        """The fleet-spawner contract end to end in real processes:
+        the parent's active cache reaches a spawned `cli serve` child
+        through DL4J_TPU_COMPILE_CACHE alone (no flags), the cold child
+        populates store + plan, and after a kill the RESPAWNED member
+        boots warm — plan replayed, zero recompiles after warmup,
+        faster warmup than the victim's."""
+        import time
+
+        from deeplearning4j_tpu.scaleout.checkpoint import \
+            DefaultModelSaver
+        from deeplearning4j_tpu.serving.fleet import ReplicaSpawner
+
+        ckpt = str(tmp_path / "m.ckpt")
+        DefaultModelSaver(ckpt, keep_old=False).save(_net())
+        cc.activate(str(tmp_path / "cache"))
+        spawner = ReplicaSpawner(ckpt, serve_args=["--max-delay-ms", "1"])
+        x = np.random.RandomState(0).rand(2, 4)
+
+        def ready_stats(url):
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                try:
+                    if _get(f"{url}/readyz")["ready"]:
+                        return _get(f"{url}/stats")
+                except Exception:  # noqa: BLE001 — 503 until warm
+                    pass
+                time.sleep(0.05)
+            raise AssertionError("replica never became ready")
+
+        proc, url = spawner.spawn()
+        try:
+            cold = ready_stats(url)
+            assert cold["compile_cache"]["misses"] >= 1
+            ref = _post(f"{url}/predict", {"inputs": x.tolist()})
+        finally:
+            proc.kill()      # the drill: replica dies
+            proc.wait(timeout=30)
+
+        proc2, url2 = spawner.spawn()   # capacity repair respawns
+        try:
+            warm = ready_stats(url2)
+            assert warm["warmup"]["plan_replayed"]["engines"] >= 1
+            assert warm["warmup"]["recompiled_after_warmup"] == 0
+            assert warm["compile_cache"]["hits"] >= 1
+            assert warm["compile_cache"]["misses"] == 0
+            assert (warm["warmup"]["seconds"]
+                    < cold["warmup"]["seconds"])
+            out = _post(f"{url2}/predict", {"inputs": x.tolist()})
+            np.testing.assert_allclose(out["outputs"], ref["outputs"],
+                                       atol=1e-6)
+        finally:
+            proc2.kill()
+            proc2.wait(timeout=30)
+
+
+# ------------------------------------------------------------- trainer
+class TestTrainerWarmStart:
+    def test_fit_warm_boot(self, tmp_path):
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[
+            np.random.RandomState(1).randint(0, 3, 16)]
+        cc.activate(str(tmp_path))
+        n1 = _net()
+        n1.fit(x, y, epochs=2)
+        p1 = n1.predict(x)
+        assert cc.stats()["entries"] >= 1
+        cc.deactivate()
+        cc.activate(str(tmp_path))
+        hits0 = cc.stats()["hits"]
+        n2 = _net()
+        n2.fit(x, y, epochs=2)
+        assert cc.stats()["hits"] > hits0   # train step loaded, not built
+        assert (n2.predict(x) == p1).all()
+
+    def test_fit_scan_warm_boot(self, tmp_path):
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[
+            np.random.RandomState(1).randint(0, 3, 16)]
+        cc.activate(str(tmp_path))
+        s1 = _net().fit_scan(x, y, batch_size=8, epochs=3)
+        cc.deactivate()
+        cc.activate(str(tmp_path))
+        misses0 = cc.stats()["misses"]
+        s2 = _net().fit_scan(x, y, batch_size=8, epochs=3)
+        assert cc.stats()["misses"] == misses0  # whole epoch program hit
+        assert abs(s1 - s2) < 1e-6
