@@ -1593,6 +1593,272 @@ def bench_stream_failover():
         shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_fleet_prefix():
+    """Fleet KV plane drill (docs/FLEET.md "Fleet KV plane"): a
+    fleet of 4 replica processes serving one shared system prompt
+    with per-request tails — the chat-shaped traffic the plane
+    exists for. Two phases over the SAME warm fleet (distinct
+    system prompts per phase, so neither inherits the other's
+    caches):
+
+    - fleet_kv=off router: round-robin sprays the shared head
+      across the fleet, every replica pays its own cold prefill —
+      the single-replica cache's fleet-wide reduction collapses.
+    - fleet_kv=on router: prefix affinity converges the head onto
+      one replica (tail-only prefill from request 2 on), and under
+      a concurrent hammer the slack-bounded spill ships the hot
+      pages peer-to-peer instead of recomputing them.
+
+    Gates: fleet-wide prefill-token reduction >= 4x with affinity
+    (and strictly above the off-mode figure), zero client-visible
+    stream failures with the AFFINITY HOLDER SIGKILLed mid-hammer,
+    p99 no worse than the same hammer+kill without affinity (a dead
+    preferred replica must not convoy), >= 1 real page ship, and
+    `dl4j_fleet_prefix_{affinity_hits,page_ships}` scraped live off
+    the router's /metrics."""
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
+    from deeplearning4j_tpu.serving import fleetkv
+    from deeplearning4j_tpu.serving.fleet import READY, Fleet, ReplicaSpawner
+    from deeplearning4j_tpu.serving.router import serve_fleet
+    from deeplearning4j_tpu.testing import chaos as chaos_mod
+
+    fast = _fast()
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(4).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=3)
+            .pretrain(False).build())
+    work = tempfile.mkdtemp(prefix="dl4j_bench_fleetkv_")
+    ckpt = os.path.join(work, "fleetkv.ckpt")
+    DefaultModelSaver(ckpt, keep_old=False).save(MultiLayerNetwork(conf))
+    spec = os.path.join(work, "tf.json")
+    with open(spec, "w") as f:
+        _json.dump({"vocab_size": 17, "d_model": 32, "n_heads": 2,
+                    "n_layers": 2, "d_ff": 64, "max_len": 96,
+                    "interpret": fast, "seed": 0}, f)
+    # pace token emission so both phases' SIGKILLs land MID-stream —
+    # without it the hammer streams finish before the kill and the
+    # p99 comparison is a control in name only
+    delay_s = 0.03
+    env = dict(os.environ,
+               **chaos_mod.env_spec([chaos_mod.Rule(
+                   "generate.midstream", "delay", delay_s=delay_s)]))
+    # one shared CPU core: donors answer /kv/export while decoding, so
+    # give ships headroom over the 2 s production default — expiry
+    # would silently fall back to plain prefill and starve the drill
+    spawner = ReplicaSpawner(
+        ckpt, serve_args=["--max-delay-ms", "1", "--transformer", spec,
+                          "--slots", "8", "--page-size", "8",
+                          "--kv-pages", "64", "--fleet-kv", "on",
+                          "--kv-ship-timeout", "10"],
+        env=env)
+
+    n_fleet = 4
+    # shared system prompt = 5 full KV pages, per-request tail = 1:
+    # with affinity every request after the first prefills only its
+    # tail, so the fleet-wide reduction approaches 6x (48/8) while
+    # round-robin re-pays the head once per replica
+    head_len, tail_len = 40, 8
+    n_tokens = 4          # calm phase: measure prefill, not decode
+    n_hammer_tokens = 24  # hammer: long enough to be killed mid-flight
+    n_calm = 16 if fast else 24
+    n_hammer = 8 if fast else 16
+
+    def prompts_for(seed):
+        rng = np.random.RandomState(seed)
+        head = rng.randint(1, 17, (head_len,)).tolist()
+        return [head + rng.randint(1, 17, (tail_len,)).tolist()
+                for _ in range(max(n_calm, n_hammer))]
+
+    def post(url, payload, timeout=300):
+        req = urllib.request.Request(
+            url, data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return _json.loads(r.read())
+
+    def fleet_prefill():
+        total = 0
+        for r in fleet._replicas.values():
+            if r.state != READY:
+                continue
+            try:
+                total += (r.client.stats()["generate"]["decode"]
+                          ["prefill_tokens"])
+            except Exception:
+                pass
+        return total
+
+    def calm_phase(router, prompts):
+        """Sequential requests; returns (reduction, latencies)."""
+        before = fleet_prefill()
+        lats = []
+        for pr in prompts[:n_calm]:
+            t0 = time.perf_counter()
+            post(f"{router.url}/generate",
+                 {"prompt": [pr], "max_tokens": n_tokens})
+            lats.append(time.perf_counter() - t0)
+        submitted = sum(len(p) for p in prompts[:n_calm])
+        measured = max(1, fleet_prefill() - before)
+        return submitted / measured, lats
+
+    def hammer_phase(router, prompts, wait_ships=False):
+        """Concurrent durable streams + SIGKILL mid-drill. The victim
+        is the busiest replica — with affinity on that IS the
+        prefix holder/donor, so the drill proves a dead preferred
+        replica cannot convoy routing. Streams launch in two waves:
+        the first fills the preferred replica past PLACEMENT_SLACK so
+        the second wave demonstrably spills (off-donor landings ->
+        donor hints -> page ships); with `wait_ships` the kill holds
+        until the fleet counters show a ship landed — the donor dies
+        AFTER proving the plane works, while its streams are still
+        mid-flight."""
+        lats, errors, resumes = [], [], [0]
+
+        def worker(i):
+            body = {"prompt": [prompts[i % len(prompts)]],
+                    "max_tokens": n_hammer_tokens, "stream": True}
+            try:
+                t0 = time.perf_counter()
+                req = urllib.request.Request(
+                    f"{router.url}/generate",
+                    data=_json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"})
+                events = []
+                with urllib.request.urlopen(req, timeout=300) as r:
+                    for ln in r:
+                        if ln.strip():
+                            events.append(_json.loads(ln))
+                lats.append(time.perf_counter() - t0)
+                toks = [e for e in events if "token" in e]
+                if not (events and events[-1].get("done")
+                        and len(toks) == n_hammer_tokens):
+                    errors.append(
+                        f"stream {i}: bad terminal "
+                        f"({len(toks)}/{n_hammer_tokens} tokens)")
+                else:
+                    resumes[0] += events[-1].get("resumes", 0)
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"stream {i}: {e!r}")
+
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    daemon=True)
+                   for i in range(n_hammer)]
+        wave1 = fleetkv.PLACEMENT_SLACK + 1  # fills the preference
+        for t in threads[:wave1]:
+            t.start()
+        time.sleep(0.4)
+        for t in threads[wave1:]:            # these spill (and ship)
+            t.start()
+        if wait_ships:
+            ship_by = time.monotonic() + 8.0
+            while time.monotonic() < ship_by:
+                if fleet.snapshot()["prefix_cache"]["page_ships"] >= 1:
+                    break
+                time.sleep(0.05)
+        victim = None
+        kill_by = time.monotonic() + 30.0
+        while victim is None and time.monotonic() < kill_by:
+            busy = sorted((r for r in fleet._replicas.values()
+                           if r.outstanding and r.proc is not None),
+                          key=lambda r: -r.outstanding)
+            victim = busy[0] if busy else None
+            time.sleep(0.01)
+        if victim is not None:
+            time.sleep(6 * delay_s)  # a few tokens in flight
+            chaos_mod.sigkill(victim.proc)
+        for t in threads:
+            t.join(timeout=300)
+        return lats, errors, resumes[0]
+
+    def p99(xs):
+        return (sorted(xs)[max(0, int(len(xs) * 0.99) - 1)]
+                if xs else None)
+
+    fleet = Fleet(spawner=spawner, heartbeat_interval=0.2,
+                  heartbeat_timeout=3.0, breaker_threshold=2,
+                  breaker_reset_s=0.4)
+    router = None
+    try:
+        fleet.spawn(n_fleet)
+        fleet.wait_ready(n_fleet, timeout=600)
+
+        # ---- phase OFF: same fleet, affinity-blind router
+        router = serve_fleet(fleet, fleet_kv="off")
+        off_reduction, _ = calm_phase(router, prompts_for(1))
+        off_lats, off_errs, _ = hammer_phase(router, prompts_for(2))
+        router.http.close()  # keep the fleet; retire only the router
+        router = None
+        fleet.spawn(1)  # refill the killed slot (no auto-respawn)
+        fleet.wait_ready(n_fleet, timeout=600)
+
+        # ---- phase ON: affinity + shipping (fresh system prompt, so
+        # nothing phase OFF cached can leak into the measurement)
+        router = serve_fleet(fleet, fleet_kv="on")
+        on_reduction, _ = calm_phase(router, prompts_for(3))
+        on_lats, on_errs, resumes = hammer_phase(
+            router, prompts_for(3), wait_ships=True)
+
+        time.sleep(1.0)  # let heartbeat probes fold final ship stats
+        stats = fleet.snapshot()["prefix_cache"]
+        with urllib.request.urlopen(f"{router.url}/metrics",
+                                    timeout=30) as r:
+            metrics_text = r.read().decode()
+        scraped = all(
+            s in metrics_text
+            for s in ("dl4j_fleet_prefix_affinity_hits",
+                      "dl4j_fleet_prefix_page_ships"))
+
+        op99, fp99 = p99(on_lats), p99(off_lats)
+        # "zero affinity-induced regression": the same hammer+kill
+        # without affinity is the control; allow measurement noise
+        p99_ok = bool(op99 and fp99 and op99 <= max(1.5 * fp99,
+                                                    fp99 + 1.0))
+        return {
+            "value": round(on_reduction, 2),
+            "unit": "fleet_prefill_token_reduction",
+            "replicas": n_fleet,
+            "calm_requests": n_calm,
+            "hammer_streams": n_hammer,
+            "reduction_affinity_off": round(off_reduction, 2),
+            "reduction_affinity_on": round(on_reduction, 2),
+            "affinity_hits": stats["affinity"]["hits"],
+            "affinity_hit_rate": stats["affinity"]["rate"],
+            "page_ships": stats["page_ships"],
+            "ship_bytes": stats["ship_bytes"],
+            "ship_failures": stats["ship_failures"],
+            "stream_failures": len(on_errs) + len(off_errs),
+            "failure_sample": (on_errs + off_errs)[:3],
+            "failover_resumes": resumes,
+            "p99_off_ms": round(fp99 * 1e3, 1) if fp99 else None,
+            "p99_on_ms": round(op99 * 1e3, 1) if op99 else None,
+            "gate_reduction_4x": on_reduction >= 4.0,
+            "gate_beats_affinity_off": on_reduction > off_reduction,
+            "gate_zero_stream_failures": not (on_errs or off_errs),
+            "gate_no_affinity_p99_regression": p99_ok,
+            "gate_affinity_hits": stats["affinity"]["hits"] >= 1,
+            "gate_page_shipped": stats["page_ships"] >= 1,
+            "gate_metrics_scraped": scraped,
+        }
+    finally:
+        if router is not None:
+            router.close(stop_replicas=True)
+        else:
+            fleet.close(stop_replicas=True)
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_slo_tiers():
     """SLO tiers drill (docs/SERVING.md "Priority tiers"): saturate a
     fleet's decode slots with batch-tier /generate streams, then run
@@ -3251,6 +3517,7 @@ CONFIGS = {
     "chaos": bench_chaos,
     "warmup": bench_warmup,
     "stream_failover": bench_stream_failover,
+    "fleet_prefix": bench_fleet_prefix,
     "slo_tiers": bench_slo_tiers,
     "train_elastic": bench_train_elastic,
     "controlplane": bench_controlplane,
@@ -3277,6 +3544,7 @@ METRIC_NAMES = {
     "chaos": "chaos_sigstop_breaker_eviction_s",
     "warmup": "serving_warm_boot_warmup_speedup",
     "stream_failover": "serving_stream_failover_p99_ttnt_ms",
+    "fleet_prefix": "fleet_prefix_prefill_token_reduction",
     "slo_tiers": "serving_interactive_p99_under_batch_flood_ms",
     "train_elastic": "train_elastic_kill_recovery_s",
     "controlplane": "controlplane_router_restart_recovery_s",

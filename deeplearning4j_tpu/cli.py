@@ -478,6 +478,8 @@ def cmd_serve(args) -> int:
             slots=args.slots, page_size=args.page_size,
             kv_pages=args.kv_pages,
             prefix_cache=args.prefix_cache,
+            fleet_kv=args.fleet_kv,
+            kv_ship_timeout=args.kv_ship_timeout,
             decode_kernel=args.decode_kernel,
             horizon=args.horizon,
             speculation=args.speculation,
@@ -511,6 +513,9 @@ def cmd_serve(args) -> int:
                                            if loop is not None else None),
                           },
                           "prefix_cache": args.prefix_cache,
+                          "fleet_kv": (loop.fleet_kv
+                                       if loop is not None
+                                       else args.fleet_kv),
                           "slots": args.slots,
                           "batch_share": args.batch_share,
                           "page_size": args.page_size,
@@ -575,7 +580,12 @@ def cmd_fleet(args) -> int:
         args.model if args.model and os.path.isdir(args.model) else None)
     spawner = None
     if args.model and (args.replicas > 0 or autoscaler is not None):
-        spawner = ReplicaSpawner(args.model, serve_args=args.serve_arg)
+        # the fleet's KV mode leads the spawned replicas' serve args so
+        # an explicit --serve-arg from the operator still wins (later
+        # argparse occurrence overrides)
+        spawner = ReplicaSpawner(
+            args.model,
+            serve_args=["--fleet-kv", args.fleet_kv] + args.serve_arg)
     tele = _Telemetry(args)
     fleet = Fleet(spawner=spawner,
                   heartbeat_interval=args.heartbeat_interval,
@@ -612,7 +622,8 @@ def cmd_fleet(args) -> int:
                        if r["spawned"] and r["state"] != "evicted")
             if args.replicas > have:
                 fleet.spawn(args.replicas - have)
-        handle = serve_fleet(fleet, host=args.host, port=args.port)
+        handle = serve_fleet(fleet, host=args.host, port=args.port,
+                             fleet_kv=args.fleet_kv)
         fleet.wait_ready(1, timeout=args.ready_timeout)
     except BaseException:
         if handle is not None:
@@ -1256,6 +1267,23 @@ def build_parser() -> argparse.ArgumentParser:
                          help="cross-request KV prefix sharing in the "
                               "decode pool (--no-prefix-cache disables; "
                               "docs/SERVING.md)")
+    p_serve.add_argument("--fleet-kv", default="on",
+                         choices=("on", "affinity-only", "off"),
+                         help="this replica's half of the fleet KV "
+                              "plane: `on` publishes the affinity "
+                              "summary on /readyz AND serves "
+                              "/kv/export + fetches from donors, "
+                              "`affinity-only` publishes but never "
+                              "ships pages, `off` disables both "
+                              "(docs/FLEET.md \"Fleet KV plane\")")
+    p_serve.add_argument("--kv-ship-timeout", type=float, default=2.0,
+                         metavar="S",
+                         help="budget for one donor page fetch + "
+                              "install (seconds; request deadlines "
+                              "cap it further). Raise it when donors "
+                              "run compute-starved — expiry just "
+                              "falls back to plain prefill "
+                              "(docs/FLEET.md \"Fleet KV plane\")")
     p_serve.add_argument("--decode-kernel", default="auto",
                          choices=("auto", "pallas", "gather"),
                          help="decode attention lane: pallas streams "
@@ -1404,6 +1432,15 @@ def build_parser() -> argparse.ArgumentParser:
                          metavar="ARG",
                          help="extra flag forwarded to each spawned "
                               "replica's `serve` (repeatable)")
+    p_fleet.add_argument("--fleet-kv", default="on",
+                         choices=("on", "affinity-only", "off"),
+                         help="fleet KV plane mode, applied to BOTH "
+                              "the router (prefix-affinity placement, "
+                              "donor hints) and every spawned replica "
+                              "(summary publication, page shipping); "
+                              "`affinity-only` routes by prefix but "
+                              "never ships pages "
+                              "(docs/FLEET.md \"Fleet KV plane\")")
     p_fleet.add_argument("--state-dir", default=None, metavar="DIR",
                          help="crash-safe control plane: journal "
                               "replica membership here (fleet.journal) "

@@ -158,9 +158,12 @@ from deeplearning4j_tpu.serving.errors import (TIER_BATCH,
                                                DeadlineExceededError,
                                                OverloadedError,
                                                backlog_retry_ms)
+from deeplearning4j_tpu.serving import fleetkv
 from deeplearning4j_tpu.serving.paged_kv import (copy_page,
                                                  decode_read_bytes,
+                                                 extract_page,
                                                  init_paged_pool,
+                                                 install_page,
                                                  paged_decode_step,
                                                  paged_kv_bytes,
                                                  paged_prefill,
@@ -344,7 +347,9 @@ class DecodeLoop:
     def __init__(self, params, cfg: TransformerConfig, *, slots: int = 8,
                  page_size: int = 16, n_pages: Optional[int] = None,
                  horizon: int = 1, max_waiting: Optional[int] = None,
-                 prefix_cache: bool = True, kernel: str = "auto",
+                 prefix_cache: bool = True, fleet_kv: str = "on",
+                 kv_ship_timeout: float = 2.0,
+                 kernel: str = "auto",
                  speculation: int = 0, drafter: str = "ngram",
                  draft_params=None, draft_cfg=None,
                  draft_window: int = 32, ngram: int = 3,
@@ -447,6 +452,33 @@ class DecodeLoop:
             else None)
         self._ref = np.zeros((self.n_pages,), np.int32)
         self._prefill_token_count = 0  # real tokens through prefill
+        # fleet KV plane (serving/fleetkv.py, docs/FLEET.md): affinity
+        # summaries + peer page shipping. The plane rides the prefix
+        # trie, so without a trie it is forced off.
+        if fleet_kv not in fleetkv.MODES:
+            raise ValueError(
+                f"fleet_kv must be one of {fleetkv.MODES}, "
+                f"got {fleet_kv!r}")
+        self.fleet_kv = (fleet_kv if self.prefix_cache_enabled
+                         else fleetkv.MODE_OFF)
+        #: install jobs queued for the scheduler thread — pool swaps
+        #: happen OUTSIDE the lock on that thread, so a shipped-page
+        #: scatter from a handler thread would race a prefill's swap;
+        #: routing installs through the tick serializes them for free
+        self._kv_jobs: deque = deque()
+        #: cumulative ship stats, reported in the /readyz summary so
+        #: the fleet's probe can delta them into router-side counters
+        self._ship_stats = {"page_ships": 0, "ship_bytes": 0,
+                            "ship_failures": 0}
+        #: default budget for one donor fetch + install (seconds);
+        #: request deadlines cap it further (server._generate). Raise
+        #: it when donors run compute-starved (interpret mode, shared
+        #: cores) — a slow export is still far cheaper than a cold
+        #: head prefill, and ANY expiry just falls back to prefill.
+        if kv_ship_timeout <= 0:
+            raise ValueError(f"kv_ship_timeout must be > 0, "
+                             f"got {kv_ship_timeout}")
+        self.kv_ship_timeout = float(kv_ship_timeout)
 
         # speculative decoding ----------------------------------------
         # the drafter proposes; the verify program below is the only
@@ -1049,6 +1081,219 @@ class DecodeLoop:
                                         int(draft.get("k", self.spec_k))))
         return n
 
+    # ---- fleet KV plane (serving/fleetkv.py, docs/FLEET.md)
+    def kv_summary(self) -> Optional[dict]:
+        """The affinity summary piggybacked on /readyz: cumulative
+        head-chunk fingerprints of every cached trie path (most recent
+        first, capped), plus the cache/ship counters the fleet probe
+        deltas into router-side series. None while the plane is off —
+        the readiness payload then simply omits the key. Only tokens
+        the trie RETAINS are fingerprinted; opted-out requests never
+        seeded it, so nothing prompt-derived about them leaves this
+        process."""
+        if self._prefix is None or self.fleet_kv == fleetkv.MODE_OFF:
+            return None
+        # chaos: a summary-build fault must degrade the replica to
+        # "no affinity signal", never fail the health probe
+        chaos.hit("fleet.kv_summary")
+        with self._cond:
+            return {
+                "v": 1,
+                "mode": self.fleet_kv,
+                "page_size": self.page_size,
+                "heads": fleetkv.summary_heads(self._prefix,
+                                               self.page_size),
+                "pages_cached": self.pages_cached,
+                "hits": int(self._m_hits.value),
+                "misses": int(self._m_misses.value),
+                **self._ship_stats,
+            }
+
+    def kv_export(self, tokens: Sequence[int],
+                  max_chunks: Optional[int] = None) -> Optional[bytes]:
+        """Donor half of a page ship: serialize this replica's cached
+        pages covering `tokens`' head chunks (crc-framed, no pickle —
+        fleetkv.pack_pages). None when shipping is off. The matched
+        pages are PINNED (reader refcount) for the duration of the
+        read: eviction only takes refcount-zero pages, and any writer
+        CoW-forks away from a trie-retained page, so the bytes each
+        extract sees are frozen even while the pool keeps serving —
+        and even across pool swaps, because a pinned page's content is
+        immutable in every pool generation. Runs on the HTTP handler
+        thread; only the bookkeeping takes the lock."""
+        if self._prefix is None or self.fleet_kv != fleetkv.MODE_ON:
+            return None
+        with self._cond:
+            matched = self._prefix.match(tokens)
+            if max_chunks is not None:
+                matched = matched[:int(max_chunks)]
+            for page in matched:
+                self._ref[page] += 1  # pin across the export read
+        try:
+            # chaos: donor faults mid-ship (a "hang" rule holds the
+            # pins open — the export-vs-eviction drills ride this
+            # window; "error"/"reset" drill the receiver's fallback)
+            chaos.hit("fleet.kv_ship", role="export",
+                      chunks=len(matched))
+            pool = self._pool
+            chunks = [extract_page(pool, page) for page in matched]
+        finally:
+            with self._cond:
+                for page in matched:
+                    self._release_page(page)
+                self._cond.notify_all()
+        meta = {
+            "v": 1,
+            "cache_key": self.cache_key,
+            "page_size": self.page_size,
+            "chunks": len(matched),
+            "layers": self.cfg.n_layers,
+            "shape": [self.cfg.n_heads, self.page_size,
+                      self.cfg.d_model // self.cfg.n_heads],
+        }
+        return fleetkv.pack_pages(meta, chunks)
+
+    def kv_ship(self, donor_url: str, tokens: Sequence[int],
+                timeout: Optional[float] = None) -> int:
+        """Receiver half: fetch the donor's cached pages for `tokens`'
+        head chunks and install whatever this trie is missing. Returns
+        the number of pages installed; 0 on ANY failure — shipping is
+        an optimization, the caller's admission prefills the same
+        tokens regardless. Safe from any thread: the pool scatter is
+        routed through the scheduler thread (`_kv_jobs`)."""
+        if self._prefix is None or self.fleet_kv != fleetkv.MODE_ON:
+            return 0
+        n_full = len(tokens) // self.page_size
+        if n_full == 0 or not donor_url:
+            return 0
+        with self._cond:
+            covered = len(self._prefix.match(tokens))
+        if covered >= n_full:
+            return 0  # already warm locally — nothing worth a fetch
+        if timeout is None:
+            timeout = self.kv_ship_timeout
+        try:
+            # chaos: receiver-side fetch faults (transport flakes)
+            chaos.hit("fleet.kv_ship", role="fetch", donor=donor_url)
+            payload = fleetkv.fetch_pages(
+                donor_url, tokens[:n_full * self.page_size], timeout,
+                max_chunks=n_full)
+            header, chunks = fleetkv.unpack_pages(payload)
+            if header.get("cache_key") != self.cache_key:
+                raise fleetkv.ShipError(
+                    "donor/receiver decode identity mismatch — "
+                    "refusing pages from a different model, page "
+                    "size, kernel lane, or device")
+            if not chunks:
+                raise fleetkv.ShipError("donor had no cached pages")
+            installed = self._kv_install(tokens, chunks, timeout)
+        except Exception:
+            # ANY failure — transport, framing, crc, identity, pool
+            # pressure, chaos — falls back to plain prefill
+            with self._cond:
+                self._ship_stats["ship_failures"] += 1
+            return 0
+        if installed:
+            with self._cond:
+                self._ship_stats["page_ships"] += installed
+                self._ship_stats["ship_bytes"] += len(payload)
+        return installed
+
+    def _kv_install(self, tokens, chunks, timeout: float) -> int:
+        """Hand an install to the scheduler thread and wait: pool
+        swaps happen outside the lock on that thread, so a scatter
+        from this (handler) thread would race a prefill's swap. With
+        no scheduler running (manual/test mode) the caller IS the
+        scheduler — apply inline."""
+        job = {"tokens": list(tokens), "chunks": chunks,
+               "event": threading.Event(), "result": {}}
+        if self.alive:
+            with self._cond:
+                if self._closed:
+                    return 0
+                self._kv_jobs.append(job)
+                self._cond.notify_all()
+            if not job["event"].wait(timeout=max(1.0, float(timeout))):
+                raise fleetkv.ShipError(
+                    "install did not complete within the ship budget")
+        else:
+            self._run_kv_job(job)
+        err = job["result"].get("error")
+        if err is not None:
+            raise err
+        return int(job["result"].get("installed", 0))
+
+    def _service_kv_jobs(self) -> None:
+        """Scheduler-thread drain of queued shipped-page installs —
+        runs at the top of every tick, before admission, so a ship
+        that lands between ticks warms the very next `_admit` match."""
+        while True:
+            with self._cond:
+                if not self._kv_jobs:
+                    return
+                job = self._kv_jobs.popleft()
+            self._run_kv_job(job)
+
+    def _run_kv_job(self, job: dict) -> None:
+        try:
+            job["result"]["installed"] = self._kv_apply_install(
+                job["tokens"], job["chunks"])
+        except Exception as e:
+            job["result"]["error"] = e
+        finally:
+            job["event"].set()
+
+    def _drain_kv_jobs(self, exc: BaseException) -> None:
+        with self._cond:
+            while self._kv_jobs:
+                job = self._kv_jobs.popleft()
+                job["result"]["error"] = exc
+                job["event"].set()
+
+    def _kv_apply_install(self, tokens, chunks) -> int:
+        """Install shipped chunk K/V beyond this trie's current
+        coverage: pin the existing matched path (an eviction during
+        our own allocations must not consume it), allocate fresh pages
+        through the normal ladder (free list first, LRU eviction
+        second), scatter the bytes, adopt the pages into the trie,
+        then drop every pin — adopted pages land in the cached
+        (refcount-zero, trie-retained) tier exactly like a retired
+        prompt's. Runs on the scheduler thread."""
+        ps = self.page_size
+        with self._cond:
+            matched = self._prefix.match(tokens)
+            covered = len(matched)
+            depth = min(len(chunks), len(tokens) // ps)
+            if depth <= covered:
+                return 0
+            need = depth - covered
+            for page in matched:
+                self._ref[page] += 1
+            fresh: List[int] = []
+            if self._avail_pages() >= need:
+                for _ in range(need):
+                    page = self._alloc_page()
+                    if page is None:  # pragma: no cover — availability
+                        break         # was checked above
+                    fresh.append(page)
+        try:
+            if len(fresh) < need:
+                raise fleetkv.ShipError(
+                    "pool has no headroom for shipped pages")
+            pool = self._pool
+            for j, page in enumerate(fresh):
+                pool = install_page(pool, page, chunks[covered + j])
+            self._pool = pool  # scheduler thread: no concurrent swap
+            with self._cond:
+                adopted = self._prefix.insert(
+                    tokens[:depth * ps], matched + fresh)
+            return adopted
+        finally:
+            with self._cond:
+                for page in matched + fresh:
+                    self._release_page(page)
+                self._cond.notify_all()
+
     def snapshot(self) -> dict:
         with self._cond:
             return {
@@ -1110,6 +1355,10 @@ class DecodeLoop:
                     "nodes": (0 if self._prefix is None
                               else len(self._prefix)),
                 },
+                "fleet_kv": {
+                    "mode": self.fleet_kv,
+                    **self._ship_stats,
+                },
                 "speculation": {
                     "enabled": bool(self.spec_k),
                     "k": self.spec_k,
@@ -1149,10 +1398,13 @@ class DecodeLoop:
         while True:
             with self._cond:
                 while (not self._closed and not self._waiting
+                       and not self._kv_jobs
                        and self.occupied_slots == 0):
                     self._cond.wait(timeout=0.1)
                 if (self._closed and not self._waiting
                         and self.occupied_slots == 0):
+                    self._drain_kv_jobs(
+                        RuntimeError("decode loop closed"))
                     return
             try:
                 self.tick()
@@ -1163,6 +1415,7 @@ class DecodeLoop:
                 return
 
     def _fail_all(self, exc: BaseException) -> None:
+        self._drain_kv_jobs(exc)
         with self._cond:
             self._deferred = []
             for i, slot in enumerate(self._slot_state):
@@ -1183,6 +1436,9 @@ class DecodeLoop:
         tests (and `start=False` callers) can drive the loop
         deterministically."""
         self._reap()
+        # shipped-page installs land before admission so the very next
+        # `_admit` match sees them as cached chunks
+        self._service_kv_jobs()
         # chaos point: a "delay" rule paces every scheduler pass (the
         # SLO drills use it to pin slot occupancy open long enough for
         # preemption to observably fire); an "error" drills the

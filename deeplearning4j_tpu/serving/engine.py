@@ -345,6 +345,8 @@ class InferenceEngine:
                           horizon: int = 1,
                           max_waiting: Optional[int] = None,
                           prefix_cache: bool = True,
+                          fleet_kv: str = "on",
+                          kv_ship_timeout: float = 2.0,
                           kernel: str = "auto",
                           speculation: int = 0,
                           drafter: str = "ngram",
@@ -377,6 +379,8 @@ class InferenceEngine:
                                       n_pages=n_pages, horizon=horizon,
                                       max_waiting=max_waiting,
                                       prefix_cache=prefix_cache,
+                                      fleet_kv=fleet_kv,
+                                      kv_ship_timeout=kv_ship_timeout,
                                       kernel=kernel,
                                       speculation=speculation,
                                       drafter=drafter,

@@ -261,6 +261,10 @@ class FleetReplica:
         self.failures = 0          # consecutive request-path failures
         self.breaker = breaker if breaker is not None else CircuitBreaker()
         self.last_ready: Optional[dict] = None
+        #: last cumulative ship stats folded into the fleet counters
+        #: (the /readyz kv_summary reports lifetime figures; the probe
+        #: deltas them — see Fleet._fold_kv_summary)
+        self.kv_seen: Optional[dict] = None
         self.admitted_at: Optional[float] = None
         self.evicted_at: Optional[float] = None
         self.eviction_reason: Optional[str] = None
@@ -669,6 +673,36 @@ class Fleet:
             "dl4j_fleet_spawned", "replicas spawned").labels(**lab)
         self._m_retired = reg.counter(
             "dl4j_fleet_retired", "replicas retired").labels(**lab)
+        # fleet KV plane (serving/fleetkv.py, docs/FLEET.md): affinity
+        # placement counted router-side at select; ship counters are
+        # DELTAS of the cumulative per-replica figures each /readyz
+        # summary carries, folded in by the health probe — the router
+        # never sits on the ship path, yet its /metrics still tells
+        # the fleet-wide story
+        self._m_affinity_hits = reg.counter(
+            "dl4j_fleet_prefix_affinity_hits",
+            "generate requests routed to the replica whose KV summary "
+            "matched >= 1 head chunk of the prompt (the fleet-level "
+            "prefix hit)").labels(**lab)
+        self._m_affinity_misses = reg.counter(
+            "dl4j_fleet_prefix_affinity_misses",
+            "affinity-eligible generate requests with no summary "
+            "match anywhere, or whose preferred replica lost to load "
+            "slack / shed / exclusion").labels(**lab)
+        self._m_page_ships = reg.counter(
+            "dl4j_fleet_prefix_page_ships",
+            "KV pages installed via peer-to-peer shipping across the "
+            "fleet (replica-reported, probe-aggregated)").labels(**lab)
+        self._m_ship_bytes = reg.counter(
+            "dl4j_fleet_prefix_ship_bytes",
+            "serialized bytes fetched by successful page ships "
+            "(replica-reported, probe-aggregated)").labels(**lab)
+        self._m_ship_failures = reg.counter(
+            "dl4j_fleet_prefix_ship_failures",
+            "page-ship attempts that fell back to plain prefill "
+            "(donor dead, timeout, crc/identity mismatch, pool "
+            "pressure; replica-reported, probe-aggregated)").labels(
+                **lab)
         ref = weakref.ref(self)
         for state in STATES:
             reg.gauge(
@@ -1009,6 +1043,7 @@ class Fleet:
                     rep.breaker.reopen()
             return
         rep.last_ready = payload
+        self._fold_kv_summary(rep, payload)
         if ready and rep.state in (STARTING, EVICTED):
             with self._lock:
                 rep.breaker.record_success()  # closes a half-open trial
@@ -1019,6 +1054,95 @@ class Fleet:
                     rep.breaker.reopen()
             if rep.state in (READY, SUSPECT):
                 self._evict(rep, payload.get("reason", "readiness lost"))
+
+    # ---------------------------------------- fleet KV plane (fleetkv)
+    def _fold_kv_summary(self, rep: FleetReplica,
+                         payload: dict) -> None:
+        """Delta one replica's cumulative ship stats (carried by its
+        /readyz kv_summary) into the fleet-level counters. A replica
+        restart resets its cumulative figures — a negative delta means
+        exactly that, so the new figure is taken whole."""
+        summary = (payload or {}).get("kv_summary")
+        if not isinstance(summary, dict):
+            return
+        with self._lock:
+            seen = rep.kv_seen or {}
+            for key, counter in (
+                    ("page_ships", self._m_page_ships),
+                    ("ship_bytes", self._m_ship_bytes),
+                    ("ship_failures", self._m_ship_failures)):
+                now = int(summary.get(key, 0))
+                delta = now - int(seen.get(key, 0))
+                if delta < 0:
+                    delta = now
+                if delta > 0:
+                    counter.inc(delta)
+                seen[key] = now
+            rep.kv_seen = seen
+
+    def kv_summaries(self) -> dict:
+        """READY replicas' affinity summaries: {replica_id ->
+        (kv_summary payload, url)}. The router's placement input
+        (fleetkv.RouterAffinity.plan); replicas without a summary
+        (plane off, pre-first-probe, summary chaos) simply don't
+        appear — affinity degrades, routing never blocks on it."""
+        with self._lock:
+            out = {}
+            for rid, rep in self._replicas.items():
+                if rep.state != READY:
+                    continue
+                summary = (rep.last_ready or {}).get("kv_summary")
+                if isinstance(summary, dict):
+                    out[rid] = (summary, rep.client.url)
+            return out
+
+    def note_affinity(self, hit: bool) -> None:
+        """Router-side placement outcome: hit = the request landed on
+        the replica whose summary matched its head chunks."""
+        (self._m_affinity_hits if hit
+         else self._m_affinity_misses).inc()
+
+    def _prefix_section(self) -> dict:
+        """Fleet-wide prefix-cache view for /stats: each replica's
+        last-reported hit/page figures plus the fleet totals and the
+        router's affinity hit rate. Figures come from the same
+        kv_summary the affinity plane rides on, so a replica whose
+        plane is off simply contributes zeros."""
+        per: Dict[str, dict] = {}
+        hits = misses = pages = ships = 0
+        with self._lock:
+            for rid, rep in self._replicas.items():
+                summary = (rep.last_ready or {}).get("kv_summary")
+                if not isinstance(summary, dict):
+                    continue
+                row = {
+                    "hits": int(summary.get("hits", 0)),
+                    "misses": int(summary.get("misses", 0)),
+                    "pages_cached": int(summary.get("pages_cached", 0)),
+                    "page_ships": int(summary.get("page_ships", 0)),
+                }
+                per[rid] = row
+                hits += row["hits"]
+                misses += row["misses"]
+                pages += row["pages_cached"]
+                ships += row["page_ships"]
+        ahits = int(self._m_affinity_hits.value)
+        amisses = int(self._m_affinity_misses.value)
+        placed = ahits + amisses
+        return {
+            "replicas": per,
+            "hits": hits,
+            "misses": misses,
+            "pages_cached": pages,
+            "page_ships": ships,
+            "ship_bytes": int(self._m_ship_bytes.value),
+            "ship_failures": int(self._m_ship_failures.value),
+            "affinity": {
+                "hits": ahits,
+                "misses": amisses,
+                "rate": round(ahits / placed, 4) if placed else 0.0,
+            },
+        }
 
     def _needs_converge(self, rep: FleetReplica) -> bool:
         """True when `rep` reports a checkpoint identity other than the
@@ -1196,7 +1320,9 @@ class Fleet:
     def select(self, route: str = "predict",
                exclude: Sequence[str] = (),
                tier: str = TIER_INTERACTIVE,
-               count: bool = True) -> FleetReplica:
+               count: bool = True,
+               prefer: Optional[str] = None,
+               prefer_slack: int = 4) -> FleetReplica:
         """Least-outstanding READY replica (round-robin tiebreak) —
         the ReplicaSet policy lifted across processes. SUSPECT
         replicas (recent request timeouts, breaker not yet open) stay
@@ -1215,7 +1341,17 @@ class Fleet:
         mark — and the BATCH tier additionally past its own, lower
         `batch_high_water`, with Retry-After derived from the shed
         tier's backlog. Raises NoReadyReplicas when nothing is
-        admittable. The caller owns `release(rep, tier)` (same tier)."""
+        admittable. The caller owns `release(rep, tier)` (same tier).
+
+        `prefer` names a replica the fleet KV plane wants this request
+        on (prefix affinity / consistent-hash placement —
+        serving/fleetkv.py). It is a PREFERENCE with strict bounds:
+        honored only when the target is READY (never SUSPECT — a
+        suspect must not attract a convoy of its favorite prefix), not
+        excluded, and within `prefer_slack` outstanding requests of
+        the least-loaded candidate. Every shed above still fires
+        first; when the preference loses, selection falls back to the
+        least-outstanding policy unchanged."""
         if tier not in TIERS:
             raise ValueError(
                 f"unknown tier {tier!r} (expected one of {TIERS})")
@@ -1263,9 +1399,19 @@ class Fleet:
                         _TIER_ITEM_MS[tier]),
                     tier=tier)
             n = len(ids)
-            best = min(ready, key=lambda r: (
-                r.outstanding, r.state == SUSPECT,
-                (ids.index(r.id) - self._rr) % n))
+            best = None
+            if prefer is not None:
+                cand = next((r for r in ready
+                             if r.id == prefer and r.state == READY),
+                            None)
+                if cand is not None:
+                    floor = min(r.outstanding for r in ready)
+                    if cand.outstanding - floor <= prefer_slack:
+                        best = cand
+            if best is None:
+                best = min(ready, key=lambda r: (
+                    r.outstanding, r.state == SUSPECT,
+                    (ids.index(r.id) - self._rr) % n))
             self._rr = (ids.index(best.id) + 1) % n
             best.outstanding += 1
             self._tier_inflight[tier] += 1
@@ -1679,6 +1825,7 @@ class Fleet:
                 "preempt_resumes": int(self._m_preempt_resumes.value),
                 "utilization": round(self.utilization(), 4),
             },
+            "prefix_cache": self._prefix_section(),
             "evictions": int(self._m_evictions.value),
             "readmissions": int(self._m_readmissions.value),
             "reloads": {outcome: int(c.value)

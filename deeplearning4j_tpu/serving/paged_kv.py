@@ -53,7 +53,8 @@ from deeplearning4j_tpu.serving.kv_cache import _ffn, _heads
 __all__ = ["PagedKVPool", "init_paged_pool", "paged_kv_bytes",
            "pages_per_slot", "pages_for_tokens", "prompt_buckets",
            "paged_prefill", "paged_prefill_ctx", "paged_decode_step",
-           "paged_verify_step", "copy_page", "decode_read_bytes"]
+           "paged_verify_step", "copy_page", "extract_page",
+           "install_page", "decode_read_bytes"]
 
 
 class PagedKVPool(NamedTuple):
@@ -200,6 +201,41 @@ def copy_page(pool: PagedKVPool, src, dst) -> PagedKVPool:
                     "v": layer["v"].at[dst].set(layer["v"][src])}
                    for layer in pool.layers)
     return PagedKVPool(layers)
+
+
+def extract_page(pool: PagedKVPool, page: int):
+    """Host-side copy of ONE physical page across every layer — the
+    fleet KV plane's export read (serving/fleetkv.py). Returns a list
+    of (k, v) numpy arrays of shape (n_heads, page_size, head_dim),
+    one pair per layer. Pure reads on the immutable pool arrays: a
+    concurrent pool swap in the decode loop cannot tear a page whose
+    content is pinned (CoW writers fork elsewhere)."""
+    import numpy as np
+
+    return [(np.asarray(layer["k"][page]), np.asarray(layer["v"][page]))
+            for layer in pool.layers]
+
+
+def install_page(pool: PagedKVPool, page: int, chunk) -> PagedKVPool:
+    """Write one shipped page's K/V rows (`chunk[l] = (k, v)` per
+    layer, the `extract_page` shape) into pool index `page`. Eager
+    single-page scatters — constant shapes, so XLA caches one program
+    per dtype regardless of how many pages ever ship, and nothing here
+    touches the decode loop's jitted program set."""
+    if len(chunk) != len(pool.layers):
+        raise ValueError(
+            f"shipped page has {len(chunk)} layers, pool has "
+            f"{len(pool.layers)}")
+    want = pool.layers[0]["k"].shape[1:]
+    layers = []
+    for layer, (k, v) in zip(pool.layers, chunk):
+        if tuple(k.shape) != tuple(want) or tuple(v.shape) != tuple(want):
+            raise ValueError(
+                f"shipped page shape {tuple(k.shape)} != pool page "
+                f"shape {tuple(want)}")
+        layers.append({"k": layer["k"].at[page].set(k),
+                       "v": layer["v"].at[page].set(v)})
+    return PagedKVPool(tuple(layers))
 
 
 def paged_prefill_ctx(params, tokens, true_len, pool: PagedKVPool,

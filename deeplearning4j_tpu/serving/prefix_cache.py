@@ -96,6 +96,27 @@ class PrefixIndex:
                 node = node.parent
             yield [t for chunk in reversed(parts) for t in chunk]
 
+    def head_paths(self, max_chunks: int = 16):
+        """Yield every cached token path (root-to-leaf, most recently
+        touched leaf first) truncated to its first `max_chunks` chunks
+        — the fleet KV plane's summary corpus (serving/fleetkv.py).
+        Affinity fingerprints only ever cover the HEAD of a path, so
+        deep generation tails are cut before flattening; duplicates
+        from leaves sharing a head collapse in the caller's hash
+        dedup. Only retained tokens appear: a request that opted out
+        of the prefix cache never seeded the trie, so nothing about
+        it can surface here."""
+        leaves = [n for n in self._by_page.values() if not n.children]
+        leaves.sort(key=lambda n: n.tick, reverse=True)
+        for leaf in leaves:
+            parts: List[_Chunk] = []
+            node: Optional[_Node] = leaf
+            while node is not None:
+                parts.append(node.chunk)
+                node = node.parent
+            head = list(reversed(parts))[:max_chunks]
+            yield [t for chunk in head for t in chunk]
+
     def _chunks(self, tokens: Sequence[int]) -> List[_Chunk]:
         ps = self.page_size
         return [tuple(int(t) for t in tokens[j * ps:(j + 1) * ps])

@@ -79,6 +79,7 @@ from deeplearning4j_tpu.serving.errors import (DEADLINE_HEADER,
                                                deadline_body,
                                                overload_body, parse_tier,
                                                replica_failed_body)
+from deeplearning4j_tpu.serving import fleetkv
 from deeplearning4j_tpu.telemetry import exposition
 from deeplearning4j_tpu.testing import chaos
 from deeplearning4j_tpu.utils.httpd import ServerHandle, start_http_server
@@ -161,6 +162,22 @@ def _parse_continuation(data: dict):
         return (rows, eos, bool(data.get("prefix_cache", True)),
                 bool(data.get("speculation", True)))
     except (TypeError, ValueError, KeyError):
+        return None
+
+
+def _head_row(data: dict):
+    """Best-effort first prompt row as an int list for affinity
+    hashing on the passthrough path, or None when the body doesn't
+    carry token ids (string prompts route by least-outstanding).
+    Callers must already have checked the `prefix_cache` opt-out —
+    opted-out token ids are never hashed."""
+    raw = data.get("prompt")
+    if not isinstance(raw, list) or not raw:
+        return None
+    row = raw[0] if isinstance(raw[0], list) else raw
+    try:
+        return [int(t) for t in row]
+    except (TypeError, ValueError):
         return None
 
 
@@ -284,10 +301,19 @@ class FleetHandle:
 
 
 def serve_fleet(fleet, host: str = "127.0.0.1",
-                port: int = 0) -> FleetHandle:
-    """Start the router HTTP tier over a (started) Fleet."""
+                port: int = 0,
+                fleet_kv: str = fleetkv.MODE_ON) -> FleetHandle:
+    """Start the router HTTP tier over a (started) Fleet.
+
+    `fleet_kv` sets the router half of the fleet KV plane
+    (docs/FLEET.md "Fleet KV plane"): ``"on"`` routes /generate by
+    prefix affinity AND names a donor replica for peer-to-peer page
+    shipping, ``"affinity-only"`` routes but never ships,
+    ``"off"`` disables both (placement falls back to pure
+    least-outstanding)."""
     from deeplearning4j_tpu.serving.fleet import NoReadyReplicas
 
+    affinity = fleetkv.RouterAffinity(fleet_kv)
     handle = FleetHandle(fleet)
 
     class Handler(BaseHTTPRequestHandler):
@@ -427,6 +453,23 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                                           fleet.probe_timeout)
             return hop_timeout, fwd_headers or None, eligible
 
+        def _kv_place(self, tokens, use_prefix: bool):
+            """Prefix-affinity placement for one request, or None.
+
+            The opt-out contract (docs/FLEET.md): a body carrying
+            `"prefix_cache": false` reaches this with `use_prefix`
+            False and returns BEFORE any hashing — prompt-derived
+            fingerprints of opted-out requests are never computed on
+            the router, just as the replica never seeds its summary
+            with them. A placement fault degrades to least-outstanding
+            routing, never to a failed request."""
+            if not use_prefix or not affinity.enabled:
+                return None
+            try:
+                return affinity.plan(tokens, fleet.kv_summaries())
+            except Exception:
+                return None
+
         def _generate(self):
             data = self._read_json()  # parsed for stream/deadline
             streaming = bool(data.get("stream", False))
@@ -440,7 +483,7 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             try:
                 if parsed is None:
                     self._generate_passthrough(streaming, deadline,
-                                               tier)
+                                               tier, data)
                 else:
                     self._generate_durable(parsed, streaming, deadline,
                                            tier)
@@ -559,6 +602,12 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                 else:
                     self._reply(502, body)
 
+            # affinity placement hashes only the PROMPT head (chunk-
+            # aligned), so one plan covers every hop: delivered tokens
+            # extend the tail, never the head. Opted-out bodies skip
+            # the hash entirely (use_prefix False -> None).
+            placement = self._kv_place(rows[0].prompt, use_prefix)
+            affinity_noted = False
             attempt = 0
             last = (None, "no replica attempted")  # (id, detail)
             while True:
@@ -584,10 +633,16 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                                 f"{type(e).__name__}: {e}")
                         attempt += 1
                         continue
+                    prefer = (placement.prefer
+                              if placement is not None
+                              and placement.prefer not in failed
+                              else None)
                     try:
-                        replica = fleet.select(route="generate",
-                                               exclude=tuple(failed),
-                                               tier=tier)
+                        replica = fleet.select(
+                            route="generate",
+                            exclude=tuple(failed),
+                            tier=tier, prefer=prefer,
+                            prefer_slack=fleetkv.PLACEMENT_SLACK)
                     except (NoReadyReplicas, OverloadedError) as e:
                         reply_failed(last[0], f"{last[1]}; no surviving "
                                      f"replica to resume on ({e})")
@@ -596,7 +651,10 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                     try:
                         replica = fleet.select(
                             route="generate", tier=tier,
-                            count=not preempt_pending)
+                            count=not preempt_pending,
+                            prefer=(placement.prefer
+                                    if placement is not None else None),
+                            prefer_slack=fleetkv.PLACEMENT_SLACK)
                     except OverloadedError:
                         if not preempt_pending:
                             raise  # initial admission: shed the client
@@ -613,6 +671,13 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                             return
                         time.sleep(0.2)
                         continue
+                if placement is not None and not affinity_noted:
+                    # scored once per stream, on first placement: hit =
+                    # the summaries matched AND the request landed on
+                    # the matched replica
+                    affinity_noted = True
+                    fleet.note_affinity(placement.depth > 0 and
+                                        replica.id == placement.prefer)
                 hop_timeout, fwd_headers, eligible = \
                     self._hop_budget(deadline, tier)
                 body = {
@@ -633,6 +698,17 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                 }
                 if eos_id is not None:
                     body["eos_id"] = eos_id
+                if (affinity.shipping and placement is not None
+                        and placement.depth > 0
+                        and placement.donor_url
+                        and replica.id != placement.donor
+                        and placement.donor not in failed):
+                    # the request landed OFF the replica holding its
+                    # cached head (shed pressure, SUSPECT, slack): name
+                    # the donor so the receiver ships the hot pages
+                    # peer-to-peer before prefill (decode_loop.kv_ship
+                    # — any ship failure falls back to plain prefill)
+                    body["kv_donor"] = placement.donor_url
                 replayed = sum(len(r.prompt) + len(r.delivered)
                                for r in pending)
                 conn = None
@@ -900,13 +976,29 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             self.wfile.write(data)
 
         def _generate_passthrough(self, streaming, deadline,
-                                  tier=TIER_INTERACTIVE):
+                                  tier=TIER_INTERACTIVE, data=None):
             """The pre-failover path, kept for bodies that don't parse
             into a continuation record (string prompts, exotic fields,
             a client that is itself a resuming router): one replica,
             blind relay, no resume (a preempted row surfaces its
-            `"preempted"` finish_reason to the client unresumed)."""
-            replica = fleet.select(route="generate", tier=tier)
+            `"preempted"` finish_reason to the client unresumed).
+            Affinity still places token-list bodies (the body is
+            forwarded untouched, so no donor hint is injected here —
+            the affinity hit itself makes shipping unnecessary)."""
+            placement = None
+            if data is not None and bool(data.get("prefix_cache",
+                                                  True)):
+                tokens = _head_row(data)
+                if tokens:
+                    placement = self._kv_place(tokens, True)
+            replica = fleet.select(
+                route="generate", tier=tier,
+                prefer=(placement.prefer
+                        if placement is not None else None),
+                prefer_slack=fleetkv.PLACEMENT_SLACK)
+            if placement is not None:
+                fleet.note_affinity(placement.depth > 0 and
+                                    replica.id == placement.prefer)
             import http.client as _hc
 
             replica_errs = (OSError, _hc.HTTPException)

@@ -297,6 +297,18 @@ class ServingHandle:
                "checkpoint": self.replicas.checkpoint}
         if loop is not None:
             out["decode_loop_alive"] = loop.alive
+            # fleet KV plane: the affinity summary rides the SAME
+            # probe that gates admission, so the router's placement
+            # view refreshes exactly as fast as its health view. A
+            # summary fault (chaos fleet.kv_summary) degrades this
+            # replica to "no affinity signal" — it must never turn a
+            # healthy replica unready
+            try:
+                summary = loop.kv_summary()
+            except Exception:
+                summary = None
+            if summary is not None:
+                out["kv_summary"] = summary
         if self.warmup_seconds is not None:
             out["warmup_seconds"] = round(self.warmup_seconds, 4)
         if reasons:
@@ -381,6 +393,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                   kv_pages: Optional[int] = None,
                   max_waiting: Optional[int] = None,
                   prefix_cache: bool = True,
+                  fleet_kv: str = "on",
+                  kv_ship_timeout: float = 2.0,
                   decode_kernel: str = "auto",
                   horizon: int = 1,
                   speculation: int = 0,
@@ -413,7 +427,11 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
     either, requests shed with 503 + Retry-After. `prefix_cache=False`
     disables cross-request KV prefix sharing in the decode loop;
     individual requests opt out with `"prefix_cache": false` in the
-    /generate body. `decode_kernel` picks the decode attention lane
+    /generate body. `fleet_kv` tunes the fleet KV plane
+    ("on"|"affinity-only"|"off", docs/FLEET.md "Fleet KV plane"): the
+    affinity summary piggybacked on /readyz and the `POST /kv/export`
+    peer page-shipping endpoint the router's donor hints point at.
+    `decode_kernel` picks the decode attention lane
     ("auto" = Pallas paged kernel on TPU, dense gather elsewhere;
     docs/SERVING.md "Decode kernel"). `horizon > 1` chains K decode
     steps per dispatch; `speculation = k > 0` turns on draft-and-verify
@@ -468,6 +486,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                                           n_pages=kv_pages,
                                           max_waiting=max_waiting,
                                           prefix_cache=prefix_cache,
+                                          fleet_kv=fleet_kv,
+                                          kv_ship_timeout=kv_ship_timeout,
                                           kernel=decode_kernel,
                                           horizon=horizon,
                                           speculation=speculation,
@@ -598,6 +618,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                     self._generate()
                 elif self.path.startswith("/reload"):
                     self._reload()
+                elif self.path.startswith("/kv/export"):
+                    self._kv_export()
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
             except chaos.ChaosReset:
@@ -694,6 +716,32 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                 "checkpoint": replicas.checkpoint,
             })
 
+        def _kv_export(self):
+            """Donor side of a fleet KV page ship (serving/fleetkv.py):
+            serialize this replica's cached prefix pages for the
+            requested head tokens. 404 while the plane is off —
+            receivers treat any non-200 as "no donor", fall back to
+            plain prefill, and move on."""
+            loop = (generate_engine.decode_loop
+                    if generate_engine is not None else None)
+            if loop is None:
+                self._reply(404, {"error": "no decode loop"})
+                return
+            data = self._read_json()
+            tokens = data.get("tokens")
+            if not isinstance(tokens, list) or not tokens:
+                raise ValueError(
+                    "kv export needs {'tokens': [head token ids]}")
+            max_chunks = data.get("max_chunks")
+            max_chunks = None if max_chunks is None else int(max_chunks)
+            payload = loop.kv_export([int(t) for t in tokens],
+                                     max_chunks=max_chunks)
+            if payload is None:
+                self._reply(404, {"error": "fleet KV shipping is off "
+                                           "on this replica"})
+                return
+            self._reply_raw(200, "application/octet-stream", payload)
+
         def _generate(self):
             if generate_engine is None:
                 self._reply(404, {"error": "no generate engine configured"})
@@ -761,6 +809,28 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                                                max_tokens)
                 self._reply(200, {"tokens": out.astype(int).tolist()})
                 return
+            # fleet KV plane donor hint (serving/fleetkv.py): the
+            # router knows a peer holds this prompt's prefix hot —
+            # fetch + install those pages BEFORE admission so the
+            # match below sees them as cached chunks. Budget-derived
+            # timeout; ANY failure just means plain prefill. Gated on
+            # the same prefix_cache opt-out as the cache itself.
+            donor = data.get("kv_donor")
+            if donor and use_prefix:
+                ship_timeout = loop.kv_ship_timeout
+                if deadline is not None:
+                    ship_timeout = max(
+                        0.05, min(ship_timeout,
+                                  0.5 * deadline.remaining_s()))
+                shipped = set()
+                for row in prompt:
+                    head = tuple(
+                        row[:(row.size // loop.page_size)
+                            * loop.page_size].tolist())
+                    if head and head not in shipped:
+                        shipped.add(head)
+                        loop.kv_ship(str(donor), list(head),
+                                     timeout=ship_timeout)
             # all-or-nothing admission: a malformed row 400s and an
             # admission shed 503s WITHOUT orphaning row-mates' streams
             # in running slots (submit_many validates every row, then

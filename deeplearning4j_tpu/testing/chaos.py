@@ -116,6 +116,20 @@ POINTS = {
     "fleet.journal": "serving control-plane journal (the fleet/router "
                      "twin of supervisor.journal; same write/rename "
                      "ordinals and atomicity contract)",
+    "fleet.kv_ship": "fleet KV page shipping (serving/fleetkv.py), "
+                     "fired with role=export on the donor before its "
+                     "pinned pages are read out, and role=fetch on "
+                     "the receiver before it dials the donor — an "
+                     "error/reset/hang ANYWHERE here must leave the "
+                     "receiver falling back to plain prefill with a "
+                     "bit-identical stream and both pools' page "
+                     "accounting balanced (a hang on the export side "
+                     "holds the donor's pins open, proving eviction "
+                     "cannot consume a page mid-serialization)",
+    "fleet.kv_summary": "replica affinity-summary build, before the "
+                        "trie heads are hashed for /readyz — a fault "
+                        "here degrades the replica to no-affinity "
+                        "placement, never to unready",
     "compile.cache_write": "persistent AOT program store "
                            "(compilecache/store.py), fired with "
                            "op=write before the tmp entry write and "

@@ -87,6 +87,12 @@ def pytest_configure(config):
         "docs/WARMUP.md); the in-process drills run in tier-1, the "
         "fresh-subprocess replay drill also carries @slow — run the "
         "whole layer with pytest -m aot")
+    config.addinivalue_line(
+        "markers",
+        "fleetkv: fleet KV plane lane (serving/fleetkv.py: prefix-"
+        "affinity routing + peer-to-peer page shipping — docs/FLEET.md "
+        "\"Fleet KV plane\"); the in-process drills run in tier-1 — "
+        "run the whole layer with pytest -m fleetkv")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,783 @@
+"""Fleet KV plane: prefix-affinity routing + peer-to-peer page shipping.
+
+The contracts under test (serving/fleetkv.py, docs/FLEET.md "Fleet KV
+plane"):
+
+1. **Fingerprints mirror the trie**: `hash_chunks` covers exactly the
+   FULL page-aligned head chunks `PrefixIndex` would key on, and chunk
+   j's hash identifies the whole root-to-depth-j path (cumulative).
+2. **Wire format**: `pack_pages`/`unpack_pages` round-trip K/V page
+   bytes crc-framed with no pickle; ANY corruption (magic, frame crc,
+   truncation) raises ShipError — a torn ship can never install
+   garbage bytes.
+3. **Placement**: the router prefers the READY replica with the
+   deepest summary match; cold prompts get STABLE consistent-hash
+   placement (membership change only remaps the lost replica's keys).
+4. **Shipping**: a receiver installs a donor's exported pages through
+   the normal refcount/CoW machinery — the next admission treats them
+   exactly like locally-prefilled cache (bit-identical output, tail-
+   only prefill) — and falls back to plain prefill on ANY failure
+   (dead donor, chaos error/reset, identity mismatch) with the
+   three-way page invariant balanced on both ends.
+5. **Export pins beat eviction**: a page being serialized for export
+   is pinned and cannot be LRU-evicted out from under the read, even
+   with the pool under allocation pressure.
+6. **Opt-out**: `"prefix_cache": false` requests neither seed the
+   replica's summary nor get hashed on the router (positive twin
+   proves the `true` path does both).
+7. **AOT**: shipped-page admission reuses the exact `paged_prefill_ctx`
+   bucket set a locally-seeded loop compiles — no new programs on the
+   shipping path.
+8. **Fleet surface**: router /stats aggregates a fleet-wide
+   prefix-cache section; `dl4j_fleet_prefix_*` series scrape off the
+   router's /metrics.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   init_transformer_params)
+from deeplearning4j_tpu.serving import (Fleet, InferenceEngine, serve_fleet,
+                                        serve_network)
+from deeplearning4j_tpu.serving import fleetkv
+from deeplearning4j_tpu.serving.decode_loop import DecodeLoop
+from deeplearning4j_tpu.serving.kv_cache import generate_cached
+from deeplearning4j_tpu.testing import chaos
+from deeplearning4j_tpu.testing.chaos import Rule
+from deeplearning4j_tpu.utils.httpd import start_http_server
+
+pytestmark = pytest.mark.fleetkv
+
+CFG = TransformerConfig(vocab_size=17, d_model=32, n_heads=2, n_layers=2,
+                        d_ff=64, max_len=64, interpret=True)
+
+
+def _params(seed=0):
+    return init_transformer_params(jax.random.PRNGKey(seed), CFG)
+
+
+def _prompt(rng, t):
+    return rng.randint(0, CFG.vocab_size, (t,)).astype(np.int32)
+
+
+def _ref_tokens(p, prompt, n):
+    return np.asarray(generate_cached(
+        p, jnp.asarray(np.asarray(prompt)[None]), CFG, n))[0].tolist()
+
+
+def _assert_balance(loop):
+    in_use = loop.pages_in_use
+    free = len(loop._free)
+    cached_unref = loop._cached_unref()
+    assert in_use + free + cached_unref == loop.n_pages, (
+        in_use, free, cached_unref, loop.n_pages)
+
+
+def _post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+# -------------------------------------------------- hashing + placement
+class TestHashingAndRing:
+    def test_hash_chunks_mirrors_trie_chunking(self):
+        """Full chunks only; cumulative: extending the prompt never
+        changes earlier hashes (so one summary entry identifies a
+        whole trie path prefix)."""
+        toks = list(range(20))
+        h8 = fleetkv.hash_chunks(toks, 8)
+        assert len(h8) == 2                      # 20 // 8, partial dropped
+        assert fleetkv.hash_chunks(toks[:7], 8) == []
+        assert fleetkv.hash_chunks(toks + [1, 2, 3, 4], 8)[:2] == h8
+        # a divergent FIRST chunk changes every downstream hash
+        other = [99] + toks[1:]
+        assert fleetkv.hash_chunks(other, 8)[0] != h8[0]
+        assert fleetkv.hash_chunks(other, 8)[1] != h8[1]
+        # the limit caps work
+        assert fleetkv.hash_chunks(list(range(64)), 8, limit=3) == \
+            fleetkv.hash_chunks(list(range(64)), 8)[:3]
+
+    def test_ring_membership_change_only_remaps_lost_keys(self):
+        ids = ["r0", "r1", "r2", "r3"]
+        ring = fleetkv.HashRing(ids)
+        keys = list(range(0, 2 ** 32, 2 ** 24))
+        before = {k: ring.lookup(k) for k in keys}
+        smaller = fleetkv.HashRing([i for i in ids if i != "r2"])
+        moved = sum(1 for k in keys
+                    if before[k] != "r2"
+                    and smaller.lookup(k) != before[k])
+        assert moved == 0  # only r2's keys went anywhere
+        assert fleetkv.HashRing([]).lookup(1) is None
+
+    def test_plan_prefers_deepest_match_else_ring(self):
+        aff = fleetkv.RouterAffinity("on")
+        toks = list(range(16))
+        full = fleetkv.hash_chunks(toks, 8)
+        summaries = {
+            "shallow": ({"page_size": 8, "heads": full[:1]}, "http://a"),
+            "deep": ({"page_size": 8, "heads": full}, "http://b"),
+        }
+        p = aff.plan(toks, summaries)
+        assert (p.prefer, p.depth, p.donor, p.donor_url) == \
+            ("deep", 2, "deep", "http://b")
+        # cold prompt: ring placement, stable across calls, no donor
+        cold = [9] * 16
+        c1 = aff.plan(cold, summaries)
+        c2 = aff.plan(cold, summaries)
+        assert c1.depth == 0 and c1.donor is None
+        assert c1.prefer == c2.prefer
+        # nothing to say: mode off / sub-page prompt / heterogeneous ps
+        assert fleetkv.RouterAffinity("off").plan(toks, summaries) is None
+        assert aff.plan(toks[:7], summaries) is None
+        mixed = dict(summaries)
+        mixed["odd"] = ({"page_size": 4, "heads": []}, "http://c")
+        assert aff.plan(toks, mixed) is None
+        # affinity-only: places but must never ship
+        aff2 = fleetkv.RouterAffinity("affinity-only")
+        assert aff2.enabled and not aff2.shipping
+        with pytest.raises(ValueError, match="fleet-kv mode"):
+            fleetkv.RouterAffinity("sometimes")
+
+    def test_plan_matches_fresh_summary_payloads(self):
+        """Regression: the live router sees a NEW summary dict from
+        every heartbeat probe (parsed JSON, old payload freed — its
+        address routinely recycled by the next one). An early
+        id()-keyed head-set cache served the PREVIOUS payload's heads
+        for a recycled address, so the pre-warm EMPTY summary shadowed
+        the warm one forever and every deep match silently degraded
+        to ring placement. plan() must judge each payload by VALUE:
+        same summaries as fresh equal-valued dicts -> same depth,
+        and a pre-warm empty probe must not poison later ones."""
+        aff = fleetkv.RouterAffinity("on")
+        toks = list(range(16))
+        heads = fleetkv.hash_chunks(toks, 8)
+        # probe 1: replica not warm yet -> ring placement
+        cold = {"rid": ({"page_size": 8, "heads": []}, "http://a")}
+        assert aff.plan(toks, cold).depth == 0
+        # probes 2..n: warm summaries, each a fresh dict object
+        for _ in range(5):
+            warm = {"rid": ({"page_size": 8, "heads": list(heads)},
+                            "http://a")}
+            p = aff.plan(toks, warm)
+            assert (p.prefer, p.depth) == ("rid", 2)
+
+
+# -------------------------------------------------------- wire format
+class TestWireFormat:
+    def _payload(self):
+        rng = np.random.RandomState(0)
+        chunks = [[(rng.rand(2, 8, 16).astype(np.float32),
+                    rng.rand(2, 8, 16).astype(np.float32))
+                   for _ in range(2)] for _ in range(3)]
+        meta = {"v": 1, "cache_key": "ck", "page_size": 8,
+                "chunks": 3, "layers": 2, "shape": [2, 8, 16]}
+        return fleetkv.pack_pages(meta, chunks), chunks
+
+    def test_roundtrip_bit_exact(self):
+        payload, chunks = self._payload()
+        header, out = fleetkv.unpack_pages(payload)
+        assert header["cache_key"] == "ck" and header["chunks"] == 3
+        for cj, oj in zip(chunks, out):
+            for (k, v), (ok, ov) in zip(cj, oj):
+                np.testing.assert_array_equal(k, ok)
+                np.testing.assert_array_equal(v, ov)
+
+    def test_corruption_always_raises_ship_error(self):
+        payload, _ = self._payload()
+        # bad magic
+        with pytest.raises(fleetkv.ShipError):
+            fleetkv.unpack_pages(b"NOTKV00\n" + payload[8:])
+        # a flipped byte deep in some frame: crc catches it
+        body = bytearray(payload)
+        body[len(body) // 2] ^= 0xFF
+        with pytest.raises(fleetkv.ShipError):
+            fleetkv.unpack_pages(bytes(body))
+        # truncation mid-frame
+        with pytest.raises(fleetkv.ShipError):
+            fleetkv.unpack_pages(payload[:-7])
+        with pytest.raises(fleetkv.ShipError):
+            fleetkv.unpack_pages(b"")
+
+
+# ------------------------------------------------- loop-level shipping
+class TestShipping:
+    def _seeded_donor(self, p, head, **kw):
+        donor = DecodeLoop(p, CFG, slots=2, page_size=8, start=False,
+                           **kw)
+        s = donor.submit(head, 1)
+        donor.run_until_idle()
+        s.result(5)
+        return donor
+
+    def test_ship_install_bit_identical_tail_only_prefill(self):
+        """The headline path: receiver fetches the donor's head pages,
+        installs them, and the next admission prefills ONLY the tail —
+        output equals the cold reference token-for-token; both pools
+        stay balanced; ship counters move."""
+        p = _params()
+        rng = np.random.RandomState(0)
+        head = _prompt(rng, 16)
+        full = np.concatenate([head, _prompt(rng, 4)])
+        ref = _ref_tokens(p, full, 6)
+        donor = self._seeded_donor(p, head)
+        recv = DecodeLoop(p, CFG, slots=2, page_size=8, start=False)
+        try:
+            def fake_fetch(url, tokens, timeout, max_chunks=None):
+                assert url == "http://donor:1"
+                return donor.kv_export(list(tokens),
+                                       max_chunks=max_chunks)
+
+            orig = fleetkv.fetch_pages
+            fleetkv.fetch_pages = fake_fetch
+            try:
+                installed = recv.kv_ship("http://donor:1", list(head))
+            finally:
+                fleetkv.fetch_pages = orig
+            assert installed == 2
+            # a second ship of the same head is a local no-op
+            assert recv.kv_ship("http://donor:1", list(head)) == 0
+            before = recv.snapshot()
+            assert before["fleet_kv"]["page_ships"] == 2
+            assert before["fleet_kv"]["ship_bytes"] > 0
+            assert before["fleet_kv"]["ship_failures"] == 0
+            st = recv.submit(full, 6)
+            recv.run_until_idle()
+            assert st.full_sequence(5) == ref
+            snap = recv.snapshot()
+            assert snap["prefill_tokens"] - before["prefill_tokens"] == 4
+            assert snap["prefix_cache"]["hits"] == 1
+            _assert_balance(recv)
+            _assert_balance(donor)
+        finally:
+            donor.close()
+            recv.close()
+
+    def test_dead_donor_falls_back_to_plain_prefill(self):
+        p = _params()
+        rng = np.random.RandomState(1)
+        full = _prompt(rng, 20)
+        ref = _ref_tokens(p, full, 4)
+        recv = DecodeLoop(p, CFG, slots=2, page_size=8, start=False)
+        try:
+            # nothing listens on a reserved port: the fetch fails fast
+            n = recv.kv_ship("http://127.0.0.1:9", list(full),
+                             timeout=0.5)
+            assert n == 0
+            snap = recv.snapshot()["fleet_kv"]
+            assert snap["ship_failures"] == 1
+            assert snap["page_ships"] == 0
+            st = recv.submit(full, 4)
+            recv.run_until_idle()
+            assert st.full_sequence(5) == ref
+            _assert_balance(recv)
+        finally:
+            recv.close()
+
+    def test_identity_mismatch_refuses_pages(self):
+        """A payload whose cache_key names a different decode identity
+        is refused (counted as a failure), never installed."""
+        p = _params()
+        rng = np.random.RandomState(2)
+        head = _prompt(rng, 16)
+        donor = self._seeded_donor(p, head)
+        recv = DecodeLoop(p, CFG, slots=2, page_size=8, start=False)
+        try:
+            payload = donor.kv_export(list(head))
+            header, chunks = fleetkv.unpack_pages(payload)
+            header["cache_key"] = "some-other-model"
+            forged = fleetkv.pack_pages(header, chunks)
+
+            orig = fleetkv.fetch_pages
+            fleetkv.fetch_pages = lambda *a, **k: forged
+            try:
+                assert recv.kv_ship("http://x:1", list(head)) == 0
+            finally:
+                fleetkv.fetch_pages = orig
+            assert recv.snapshot()["fleet_kv"]["ship_failures"] == 1
+            assert recv.snapshot()["prefix_cache"]["pages_cached"] == 0
+            _assert_balance(recv)
+        finally:
+            donor.close()
+            recv.close()
+
+    @pytest.mark.chaos
+    @pytest.mark.parametrize("kind,at", [("error", 0), ("reset", 1)])
+    def test_chaos_mid_ship_falls_back_balanced(self, kind, at):
+        """An injected error on the receiver's fetch (ordinal 0) or a
+        reset on the donor's export read (ordinal 1): either way the
+        receiver falls back to plain prefill, the stream completes
+        bit-identically, and BOTH pools balance."""
+        p = _params()
+        rng = np.random.RandomState(3)
+        head = _prompt(rng, 16)
+        full = np.concatenate([head, _prompt(rng, 4)])
+        ref = _ref_tokens(p, full, 6)
+        donor = self._seeded_donor(p, head)
+        recv = DecodeLoop(p, CFG, slots=2, page_size=8, start=False)
+        try:
+            orig = fleetkv.fetch_pages
+            fleetkv.fetch_pages = (
+                lambda url, tokens, timeout, max_chunks=None:
+                donor.kv_export(list(tokens), max_chunks=max_chunks))
+            chaos.configure([Rule("fleet.kv_ship", kind, at=[at])])
+            try:
+                assert recv.kv_ship("http://donor:1", list(head)) == 0
+            finally:
+                chaos.deactivate()
+                fleetkv.fetch_pages = orig
+            assert recv.snapshot()["fleet_kv"]["ship_failures"] == 1
+            st = recv.submit(full, 6)
+            recv.run_until_idle()
+            assert st.full_sequence(5) == ref
+            _assert_balance(recv)
+            _assert_balance(donor)  # export pins all released
+            assert donor.pages_in_use == 0
+        finally:
+            donor.close()
+            recv.close()
+
+    @pytest.mark.chaos
+    def test_export_pin_blocks_eviction_race(self):
+        """The export-vs-eviction race: a chaos delay holds the donor's
+        export pins open while the main thread forces allocation
+        pressure. The pinned head pages must survive (only the OTHER
+        cached entry is evicted), the payload read during the window
+        must still install bit-exact bytes, and balance holds tick by
+        tick."""
+        p = _params()
+        rng = np.random.RandomState(4)
+        head = _prompt(rng, 16)
+        other = _prompt(rng, 16)
+        # pool of 4: head + other fill it with 4 cached pages, 0 free
+        donor = DecodeLoop(p, CFG, slots=2, page_size=8, n_pages=4,
+                           start=False)
+        recv = DecodeLoop(p, CFG, slots=2, page_size=8, start=False)
+        try:
+            donor.submit(head, 1)
+            donor.run_until_idle()
+            donor.submit(other, 1)
+            donor.run_until_idle()
+            assert len(donor._free) == 0
+            donor._prefix.match(list(other))  # freshen: head is LRU
+            out = {}
+            chaos.configure([Rule("fleet.kv_ship", "delay",
+                                  delay_s=0.6, at=[0])])
+            try:
+                t = threading.Thread(
+                    target=lambda: out.update(
+                        payload=donor.kv_export(list(head))))
+                t.start()
+                # wait for the pins to land
+                deadline = time.time() + 5
+                while time.time() < deadline:
+                    with donor._cond:
+                        pinned = [pg for pg in
+                                  donor._prefix.match(list(head))
+                                  if donor._ref[pg] > 0]
+                    if len(pinned) == 2:
+                        break
+                    time.sleep(0.005)
+                assert len(pinned) == 2, "export pins never appeared"
+                # allocation pressure DURING the pinned window: a cold
+                # 15-token prompt needs 2 pages; head (LRU but pinned)
+                # must be skipped — `other`'s entries go instead
+                cold = _prompt(rng, 15)
+                st = donor.submit(cold, 1)
+                for _ in range(200):
+                    donor.tick()
+                    with donor._cond:
+                        _assert_balance(donor)
+                        still = donor._prefix.match(list(head))
+                    assert len(still) == 2, \
+                        "a pinned export page was evicted"
+                    if st.done:
+                        break
+                assert st.done
+                assert donor._prefix.match(list(other)) == []
+                t.join(10)
+                assert not t.is_alive()
+            finally:
+                chaos.deactivate()
+            # the bytes read during the pressure window are the true
+            # head pages: install them elsewhere and the warm admission
+            # is bit-identical with tail-only prefill
+            _, chunks = fleetkv.unpack_pages(out["payload"])
+            assert recv._kv_install(list(head), chunks, 5.0) == 2
+            full = np.concatenate([head, _prompt(rng, 4)])
+            st2 = recv.submit(full, 6)
+            recv.run_until_idle()
+            assert st2.full_sequence(5) == _ref_tokens(p, full, 6)
+            _assert_balance(recv)
+            _assert_balance(donor)
+        finally:
+            donor.close()
+            recv.close()
+
+    def test_install_under_full_pool_fails_cleanly(self):
+        """No headroom for shipped pages: the install raises inside the
+        ship (counted as a failure), nothing is installed, and the
+        pinned matched path is released."""
+        p = _params()
+        rng = np.random.RandomState(5)
+        head = _prompt(rng, 16)
+        donor = self._seeded_donor(p, head)
+        recv = DecodeLoop(p, CFG, slots=2, page_size=8, n_pages=2,
+                          start=False)
+        try:
+            # fill the receiver's 2-page pool with a live stream
+            busy = recv.submit(_prompt(rng, 12), 3)
+            recv.tick()
+            assert recv._avail_pages() == 0
+            orig = fleetkv.fetch_pages
+            fleetkv.fetch_pages = (
+                lambda url, tokens, timeout, max_chunks=None:
+                donor.kv_export(list(tokens), max_chunks=max_chunks))
+            try:
+                assert recv.kv_ship("http://d:1", list(head)) == 0
+            finally:
+                fleetkv.fetch_pages = orig
+            assert recv.snapshot()["fleet_kv"]["ship_failures"] == 1
+            recv.run_until_idle()
+            busy.result(5)
+            _assert_balance(recv)
+        finally:
+            donor.close()
+            recv.close()
+
+    def test_modes_gate_both_halves(self):
+        """affinity-only publishes a summary but refuses to export or
+        fetch; off publishes nothing; prefix_cache=False forces the
+        plane off regardless of the requested mode."""
+        p = _params()
+        rng = np.random.RandomState(6)
+        head = _prompt(rng, 16)
+        aff = DecodeLoop(p, CFG, slots=1, page_size=8, start=False,
+                         fleet_kv="affinity-only")
+        off = DecodeLoop(p, CFG, slots=1, page_size=8, start=False,
+                         prefix_cache=False)
+        try:
+            aff.submit(head, 1)
+            aff.run_until_idle()
+            summ = aff.kv_summary()
+            assert summ["mode"] == "affinity-only" and summ["heads"]
+            assert aff.kv_export(list(head)) is None
+            assert aff.kv_ship("http://x:1", list(head)) == 0
+            assert aff.snapshot()["fleet_kv"]["ship_failures"] == 0
+            assert off.kv_summary() is None
+            assert off.snapshot()["fleet_kv"]["mode"] == "off"
+            with pytest.raises(ValueError, match="fleet_kv"):
+                DecodeLoop(p, CFG, slots=1, page_size=8, start=False,
+                           fleet_kv="maybe")
+        finally:
+            aff.close()
+            off.close()
+
+
+# ------------------------------------------------------ opt-out twin
+class TestOptOutTwin:
+    def test_replica_summary_never_sees_opted_out_prompts(self):
+        """The positive twin: an identical prompt submitted WITH the
+        cache seeds head fingerprints; the opted-out submission leaves
+        the summary empty — prompt-derived hashes of opted-out traffic
+        never leave the replica."""
+        p = _params()
+        rng = np.random.RandomState(7)
+        pr = _prompt(rng, 16)
+        loop = DecodeLoop(p, CFG, slots=2, page_size=8, start=False)
+        try:
+            loop.submit(pr, 1, prefix_cache=False)
+            loop.run_until_idle()
+            assert loop.kv_summary()["heads"] == []
+            loop.submit(pr, 1)  # the twin
+            loop.run_until_idle()
+            heads = loop.kv_summary()["heads"]
+            assert heads == fleetkv.hash_chunks(list(pr), 8)
+        finally:
+            loop.close()
+
+
+# --------------------------------------------- router + fleet surface
+def _fake_kv_replica(summary, record):
+    """A fake replica speaking just enough of the serving surface for
+    the router's durable /generate loop: healthz/readyz (with the
+    given kv_summary riding readyz) and a one-token NDJSON stream."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code, body):
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path.startswith("/healthz"):
+                self._send(200, b'{"ok": true}')
+            elif self.path.startswith("/readyz"):
+                self._send(200, json.dumps(
+                    {"ready": True, "kv_summary": summary}).encode())
+            else:
+                self._send(404, b"{}")
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            data = json.loads(self.rfile.read(length))
+            record.append(data)
+            lines = [{"row": i, "token": 1, "token_index": b}
+                     for i, b in enumerate(
+                         data.get("token_index_base",
+                                  [0] * len(data["prompt"])))]
+            lines.append({"done": True,
+                          "finish_reasons":
+                          ["max_tokens"] * len(data["prompt"])})
+            body = "".join(json.dumps(l) + "\n" for l in lines).encode()
+            self._send(200, body)
+
+    return start_http_server(Handler)
+
+
+class TestRouterAffinity:
+    def test_affinity_routes_stats_aggregate_and_metrics_scrape(self):
+        """Two fake replicas, one holding the prompt's head: every
+        request lands on the holder (beating round-robin), /stats
+        grows the fleet-wide prefix-cache section, ship stats fold
+        from replica summaries into dl4j_fleet_prefix_* series on the
+        router's live /metrics."""
+        toks = list(range(1, 17))
+        heads = fleetkv.hash_chunks(toks, 8)
+        hot_summary = {"v": 1, "mode": "on", "page_size": 8,
+                       "heads": heads, "pages_cached": 2,
+                       "hits": 5, "misses": 1,
+                       "page_ships": 3, "ship_bytes": 999,
+                       "ship_failures": 1}
+        cold_summary = {"v": 1, "mode": "on", "page_size": 8,
+                        "heads": [], "pages_cached": 0,
+                        "hits": 0, "misses": 4,
+                        "page_ships": 0, "ship_bytes": 0,
+                        "ship_failures": 0}
+        hot_reqs, cold_reqs = [], []
+        hot = _fake_kv_replica(hot_summary, hot_reqs)
+        cold = _fake_kv_replica(cold_summary, cold_reqs)
+        fleet = Fleet(start=False, heartbeat_timeout=5.0)
+        try:
+            hot_rep = fleet.attach(hot.url)
+            fleet.attach(cold.url)
+            for _ in range(3):
+                fleet.poll()
+            assert fleet.ready_count() == 2
+            with serve_fleet(fleet, fleet_kv="on") as router:
+                for _ in range(4):
+                    out = _post(f"{router.url}/generate",
+                                {"prompt": [toks], "max_tokens": 1})
+                    assert out["finish_reasons"] == ["max_tokens"]
+                # every request beat round-robin to the summary holder
+                assert len(hot_reqs) == 4 and len(cold_reqs) == 0
+                # ... and none carried a donor hint (it LANDED on the
+                # donor, so there is nothing to ship)
+                assert all("kv_donor" not in r for r in hot_reqs)
+                stats = _get(f"{router.url}/stats")["fleet"]
+                sec = stats["prefix_cache"]
+                assert sec["affinity"]["hits"] == 4
+                assert sec["affinity"]["misses"] == 0
+                assert sec["affinity"]["rate"] == 1.0
+                assert sec["hits"] == 5 and sec["pages_cached"] == 2
+                assert sec["page_ships"] == 3
+                assert sec["ship_bytes"] == 999
+                assert sec["ship_failures"] == 1
+                assert sec["replicas"][hot_rep.id]["page_ships"] == 3
+                # acceptance bar: the new series scrape LIVE off the
+                # router's /metrics
+                with urllib.request.urlopen(f"{router.url}/metrics",
+                                            timeout=30) as r:
+                    text = r.read().decode()
+                for series in ("dl4j_fleet_prefix_affinity_hits",
+                               "dl4j_fleet_prefix_affinity_misses",
+                               "dl4j_fleet_prefix_page_ships",
+                               "dl4j_fleet_prefix_ship_bytes",
+                               "dl4j_fleet_prefix_ship_failures"):
+                    assert series in text, f"{series} missing"
+                lab = f'fleet="{fleet.label}"'
+                assert (f'dl4j_fleet_prefix_affinity_hits_total'
+                        f'{{{lab}}} 4') in text
+                assert (f'dl4j_fleet_prefix_page_ships_total'
+                        f'{{{lab}}} 3') in text
+        finally:
+            fleet.close()
+            hot.close()
+            cold.close()
+
+    def test_opted_out_bodies_are_never_hashed_on_the_router(self):
+        """Router half of the opt-out twin: `"prefix_cache": false`
+        must short-circuit BEFORE any prompt hashing; the `true` twin
+        of the same body hashes (and places) normally."""
+        toks = list(range(1, 17))
+        summary = {"v": 1, "mode": "on", "page_size": 8,
+                   "heads": fleetkv.hash_chunks(toks, 8),
+                   "pages_cached": 2, "hits": 0, "misses": 0,
+                   "page_ships": 0, "ship_bytes": 0,
+                   "ship_failures": 0}
+        reqs = []
+        srv = _fake_kv_replica(summary, reqs)
+        fleet = Fleet(start=False, heartbeat_timeout=5.0)
+        calls = []
+        orig = fleetkv.hash_chunks
+
+        def spy(tokens, page_size, limit=fleetkv.MAX_HEAD_CHUNKS):
+            calls.append(list(tokens))
+            return orig(tokens, page_size, limit)
+
+        try:
+            fleet.attach(srv.url)
+            fleet.poll()
+            with serve_fleet(fleet, fleet_kv="on") as router:
+                fleetkv.hash_chunks = spy
+                try:
+                    _post(f"{router.url}/generate",
+                          {"prompt": [toks], "max_tokens": 1,
+                           "prefix_cache": False})
+                    assert calls == []  # opted out: never hashed
+                    _post(f"{router.url}/generate",
+                          {"prompt": [toks], "max_tokens": 1})
+                    assert calls and calls[0] == toks  # the twin hashes
+                finally:
+                    fleetkv.hash_chunks = orig
+                # the opt-out flag itself still reached the replica
+                assert reqs[0]["prefix_cache"] is False
+                assert reqs[1]["prefix_cache"] is True
+        finally:
+            fleet.close()
+            srv.close()
+
+
+# ----------------------------------------------------------- HTTP e2e
+class TestShipHTTP:
+    def test_p2p_ship_over_real_http(self):
+        """Two real serving processes (shared decode identity): the
+        receiver, handed a `kv_donor` hint, fetches the donor's hot
+        pages over /kv/export and prefills only the tail — output
+        bit-identical to the cold reference. A chaos fault in the
+        donor's summary build degrades its /readyz to no-signal, never
+        to unready."""
+        from deeplearning4j_tpu.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        def _net():
+            conf = (NeuralNetConfiguration.builder()
+                    .lr(0.1).n_in(4).activation_function("tanh")
+                    .optimization_algo("iteration_gradient_descent")
+                    .num_iterations(1).use_adagrad(False)
+                    .list(2).hidden_layer_sizes([8])
+                    .override(1, layer="output", loss_function="mcxent",
+                              activation_function="softmax", n_out=3)
+                    .pretrain(False).build())
+            return MultiLayerNetwork(conf)
+
+        p = _params()
+        head = list(range(1, 17))               # 2 full pages
+        full = head + [3, 1, 4, 1]
+        ref = _ref_tokens(p, full, 4)
+        donor = serve_network(
+            _net(), n_replicas=1, max_delay_ms=1.0,
+            generate_engine=InferenceEngine.for_transformer(p, CFG),
+            slots=2, page_size=8)
+        recv = serve_network(
+            _net(), n_replicas=1, max_delay_ms=1.0,
+            generate_engine=InferenceEngine.for_transformer(
+                _params(), CFG),
+            slots=2, page_size=8)
+        try:
+            # seed the donor's cache
+            _post(f"{donor.url}/generate",
+                  {"prompt": [head], "max_tokens": 1})
+            ready = _get(f"{donor.url}/readyz")
+            assert ready["kv_summary"]["heads"] == \
+                fleetkv.hash_chunks(head, 8)
+            # the receiver ships the head, then prefills only the tail
+            out = _post(f"{recv.url}/generate",
+                        {"prompt": [full], "max_tokens": 4,
+                         "kv_donor": donor.url})
+            assert out["tokens"][0] == ref
+            stats = _get(f"{recv.url}/stats")["generate"]["decode"]
+            assert stats["fleet_kv"]["page_ships"] == 2
+            assert stats["fleet_kv"]["ship_failures"] == 0
+            assert stats["prefix_cache"]["hits"] == 1
+            assert stats["prefill_tokens"] == 4  # tail only, ever
+            # a summary chaos fault must not cost readiness
+            chaos.configure([Rule("fleet.kv_summary", "error")])
+            try:
+                ready = _get(f"{donor.url}/readyz")
+                assert ready.get("ready", True) is not False
+                assert "kv_summary" not in ready
+            finally:
+                chaos.deactivate()
+            # dead-donor hint over real HTTP: plain prefill fallback,
+            # same bytes out
+            out2 = _post(f"{recv.url}/generate",
+                         {"prompt": [full], "max_tokens": 4,
+                          "prefix_cache": False,
+                          "kv_donor": "http://127.0.0.1:9"})
+            assert out2["tokens"][0] == ref
+        finally:
+            donor.close()
+            recv.close()
+
+
+# ----------------------------------------------------------- AOT twin
+@pytest.mark.aot
+class TestShippedAdmissionAOT:
+    def test_shipping_path_compiles_no_new_prefill_programs(self):
+        """Key-set equality: a loop warmed by SHIPPED pages admits the
+        same prompt through exactly the `paged_prefill_ctx` bucket set
+        a locally-seeded loop used — the shipping path adds zero
+        compiled programs, so `recompiled_after_warmup == 0` holds."""
+        p = _params()
+        rng = np.random.RandomState(9)
+        head = _prompt(rng, 16)
+        full = np.concatenate([head, _prompt(rng, 4)])
+        local = DecodeLoop(p, CFG, slots=2, page_size=8, start=False)
+        shipped = DecodeLoop(p, CFG, slots=2, page_size=8, start=False)
+        try:
+            local.submit(head, 1)
+            local.run_until_idle()
+            local.submit(full, 3)
+            local.run_until_idle()
+            s_local = set(local._plan_prefill_ctx)
+            assert s_local  # the warm tail admission used the ctx lane
+            payload = local.kv_export(list(head))
+            _, chunks = fleetkv.unpack_pages(payload)
+            assert shipped._kv_install(list(head), chunks, 5.0) == 2
+            shipped.submit(full, 3)
+            shipped.run_until_idle()
+            assert set(shipped._plan_prefill_ctx) == s_local
+            # the shipped loop never needed the cold prefill lane at all
+            assert set(shipped._plan_prefill) == set()
+            # and both plan fragments agree (what a warmup plan records)
+            assert shipped.plan_fragment()["prefill_ctx"] == \
+                local.plan_fragment()["prefill_ctx"]
+        finally:
+            local.close()
+            shipped.close()
