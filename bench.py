@@ -1859,8 +1859,330 @@ def bench_fleet_prefix():
         shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_disagg():
+    """Disaggregated-roles drill (docs/FLEET.md "Disaggregated
+    roles"): a long-prompt storm against a prefill=1/decode=2 fleet,
+    with a second model pooled on the same registry. Four legs over
+    real replica processes:
+
+    - calm: sequential long-prompt streams on the disagg fleet set
+      the decode inter-token p99 baseline.
+    - storm: staggered concurrent long-prompt streams — every prompt
+      hands off (router /prefill -> kv_donor -> page ship), so the
+      decode replicas prefill only tails and inter-token pacing holds
+      near calm; concurrent second-model traffic proves per-model
+      routing isolation (the m2 replica's prefill-token ledger must
+      match EXACTLY the m2 prompts submitted).
+    - kill: the same storm with the prefill replica SIGKILLed mid-
+      flight — every handoff that dies falls back to plain unified
+      prefill with zero client-visible failures.
+    - control: the same storm on a unified fleet of equal decode
+      capacity, where storm prefills run inline on the decode
+      scheduler and inflate inter-token gaps.
+
+    Gates: storm decode p99 <= 1.5x calm, >= 1 handoff per storm
+    prompt, zero cross-model routing errors, zero handoff-induced
+    stream failures (including the SIGKILL leg), and the
+    `dl4j_disagg_*` counters scraped live off the router's /metrics."""
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
+    from deeplearning4j_tpu.serving.fleet import Fleet, ReplicaSpawner
+    from deeplearning4j_tpu.serving.router import serve_fleet
+    from deeplearning4j_tpu.testing import chaos as chaos_mod
+
+    fast = _fast()
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(4).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=3)
+            .pretrain(False).build())
+    work = tempfile.mkdtemp(prefix="dl4j_bench_disagg_")
+    ckpt = os.path.join(work, "disagg.ckpt")
+    DefaultModelSaver(ckpt, keep_old=False).save(MultiLayerNetwork(conf))
+    spec = os.path.join(work, "tf.json")
+    with open(spec, "w") as f:
+        _json.dump({"vocab_size": 17, "d_model": 32, "n_heads": 2,
+                    "n_layers": 2, "d_ff": 64, "max_len": 96,
+                    "interpret": fast, "seed": 0}, f)
+    # pace token emission so inter-token gaps are measurable and the
+    # SIGKILL lands while handoffs/streams are genuinely in flight;
+    # the gap a storm ADDS on top of this pace is the signal
+    delay_s = 0.1
+    env = dict(os.environ,
+               **chaos_mod.env_spec([chaos_mod.Rule(
+                   "generate.midstream", "delay", delay_s=delay_s)]))
+
+    def spawner(role=None, model_id=None):
+        args = ["--max-delay-ms", "1", "--transformer", spec,
+                "--slots", "8", "--page-size", "8",
+                "--kv-pages", "64", "--fleet-kv", "on",
+                "--kv-ship-timeout", "10"]
+        if role is not None:
+            args += ["--role", role]
+        if model_id is not None:
+            args += ["--model-id", model_id]
+        return ReplicaSpawner(ckpt, serve_args=args, env=env)
+
+    # the storm's weapon is prompt-length VARIETY: page_size=8 /
+    # max_len=96 gives the prefill bucket ladder (8,16,32,64,96);
+    # calm traffic lives in bucket 64 (length 42), the storm cycles
+    # lengths that hit the three buckets calm never touched — on a
+    # unified fleet each novel bucket compiles INLINE on the decode
+    # scheduler and craters inter-token pacing, on the disagg fleet
+    # those compiles land on the prefill replica while the decode
+    # replicas prefill only warm-bucket tails
+    calm_len = 42
+    storm_lens = (12, 20, 70)       # buckets 16, 32, 96
+    n_tokens = 10
+    n_calm = 4 if fast else 8
+    n_storm = 6 if fast else 9
+    n_m2 = 3
+
+    def prompts_for(seed, n, length):
+        rng = np.random.RandomState(seed)
+        if isinstance(length, tuple):
+            lens = [length[i % len(length)] for i in range(n)]
+        else:
+            lens = [length] * n
+        return [rng.randint(1, 17, (ln,)).tolist() for ln in lens]
+
+    def post(url, payload, headers=(), timeout=300):
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(dict(headers))
+        req = urllib.request.Request(
+            url, data=_json.dumps(payload).encode(), headers=hdrs)
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return _json.loads(r.read())
+
+    def stream_gaps(router, prompt, model_id=None):
+        """One streamed request; returns (inter-token gaps s, ok)."""
+        body = {"prompt": [prompt], "max_tokens": n_tokens,
+                "stream": True}
+        hdrs = {"Content-Type": "application/json"}
+        if model_id is not None:
+            hdrs["X-Model"] = model_id
+        req = urllib.request.Request(
+            f"{router.url}/generate", data=_json.dumps(body).encode(),
+            headers=hdrs)
+        stamps, events = [], []
+        with urllib.request.urlopen(req, timeout=300) as r:
+            for ln in r:
+                if ln.strip():
+                    events.append(_json.loads(ln))
+                    if "token" in events[-1]:
+                        stamps.append(time.perf_counter())
+        ok = (events and events[-1].get("done")
+              and len(stamps) == n_tokens)
+        return ([b - a for a, b in zip(stamps, stamps[1:])], ok)
+
+    def storm(router, prompts, stagger_s=0.06, kill=None,
+              model_id=None):
+        """Staggered concurrent streams; later prompts' prefills land
+        while earlier streams decode — on a unified fleet that
+        co-schedules them with decode, on the disagg fleet they run on
+        the prefill replica. Returns (gaps, errors)."""
+        gaps, errors = [], []
+        lock = threading.Lock()
+
+        def worker(i):
+            try:
+                g, ok = stream_gaps(router, prompts[i],
+                                    model_id=model_id)
+                with lock:
+                    gaps.extend(g)
+                    if not ok:
+                        errors.append(f"stream {i}: bad terminal")
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(f"stream {i}: {e!r}")
+
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    daemon=True)
+                   for i in range(len(prompts))]
+        for i, t in enumerate(threads):
+            t.start()
+            time.sleep(stagger_s)
+            if kill is not None and i == len(threads) // 2:
+                kill()
+        for t in threads:
+            t.join(timeout=300)
+        return gaps, errors
+
+    def p99(xs):
+        return (sorted(xs)[max(0, int(len(xs) * 0.99) - 1)]
+                if xs else None)
+
+    def disagg_counters(router):
+        with urllib.request.urlopen(f"{router.url}/stats",
+                                    timeout=30) as r:
+            return _json.loads(r.read())["fleet"]["disagg"]
+
+    # ---- disagg fleet: prefill=1/decode=2 for m1, unified=1 for m2
+    fleet = Fleet(heartbeat_interval=0.2, heartbeat_timeout=3.0,
+                  breaker_threshold=2, breaker_reset_s=0.4)
+    router = None
+    try:
+        fleet.add_pool(model_id="m1", role="prefill",
+                       spawner=spawner("prefill", "m1"))
+        fleet.add_pool(model_id="m1", role="decode",
+                       spawner=spawner("decode", "m1"))
+        fleet.add_pool(model_id="m2", role="unified",
+                       spawner=spawner(None, "m2"))
+        pre_rep = fleet.spawn_pool("m1", "prefill", 1)[0]
+        fleet.spawn_pool("m1", "decode", 2)
+        m2_rep = fleet.spawn_pool("m2", "unified", 1)[0]
+        fleet.wait_ready(4, timeout=600)
+        router = serve_fleet(fleet, fleet_kv="on")
+
+        # warmup streams compile the calm buckets + decode step on
+        # every decode replica so the calm baseline measures pacing,
+        # not one-time compiles (sequential spread covers the pool)
+        for pr in prompts_for(11, 4, calm_len):
+            stream_gaps(router, pr, model_id="m1")
+        calm_gaps = []
+        for pr in prompts_for(1, n_calm, calm_len):
+            g, ok = stream_gaps(router, pr, model_id="m1")
+            assert ok, "calm stream lost tokens"
+            calm_gaps.extend(g)
+
+        # ---- storm + concurrent second-model traffic
+        before = disagg_counters(router)
+        m2_prompts = prompts_for(7, n_m2, 24)
+        m2_errors = []
+
+        def m2_traffic():
+            for pr in m2_prompts:
+                try:
+                    out = post(f"{router.url}/generate",
+                               {"prompt": [pr], "max_tokens": 2,
+                                "model_id": "m2"})
+                    if out.get("finish_reasons") != ["max_tokens"]:
+                        m2_errors.append("bad finish")
+                except Exception as e:  # noqa: BLE001
+                    m2_errors.append(repr(e))
+
+        m2_thread = threading.Thread(target=m2_traffic, daemon=True)
+        m2_thread.start()
+        storm_prompts = prompts_for(2, n_storm, storm_lens)
+        storm_gaps, storm_errors = storm(router, storm_prompts,
+                                         model_id="m1")
+        m2_thread.join(timeout=300)
+        after = disagg_counters(router)
+        handoffs_storm = after["handoffs"] - before["handoffs"]
+
+        # per-model isolation ledger: the m2 replica prefilled EXACTLY
+        # the m2 prompts — one leaked request either way breaks it
+        m2_expected = sum(len(p) for p in m2_prompts)
+        m2_stats = m2_rep.client.stats()
+        m2_prefill = m2_stats["generate"]["decode"]["prefill_tokens"]
+
+        # ---- kill leg: SIGKILL the prefill replica mid-storm
+        kill_prompts = prompts_for(3, n_storm, storm_lens)
+        _, kill_errors = storm(
+            router, kill_prompts, model_id="m1",
+            kill=lambda: chaos_mod.sigkill(pre_rep.proc))
+        final = disagg_counters(router)
+
+        with urllib.request.urlopen(f"{router.url}/metrics",
+                                    timeout=30) as r:
+            metrics_text = r.read().decode()
+        scraped = all(s in metrics_text for s in
+                      ("dl4j_disagg_handoffs",
+                       "dl4j_disagg_handoff_bytes",
+                       "dl4j_disagg_handoff_failures",
+                       "dl4j_disagg_fallbacks",
+                       "dl4j_fleet_role_replicas"))
+        router.close(stop_replicas=True)
+        router = None
+
+        # ---- control: unified fleet of equal decode capacity
+        ctl = Fleet(spawner=spawner(), heartbeat_interval=0.2,
+                    heartbeat_timeout=3.0, breaker_threshold=2,
+                    breaker_reset_s=0.4)
+        ctl_router = None
+        try:
+            ctl.spawn(3)
+            ctl.wait_ready(3, timeout=600)
+            ctl_router = serve_fleet(ctl, fleet_kv="on")
+            for pr in prompts_for(12, 6, calm_len):   # warm the pool
+                stream_gaps(ctl_router, pr)
+            ctl_calm_gaps = []
+            for pr in prompts_for(5, n_calm, calm_len):
+                g, _ = stream_gaps(ctl_router, pr)
+                ctl_calm_gaps.extend(g)
+            ctl_gaps, ctl_errors = storm(
+                ctl_router, prompts_for(4, n_storm, storm_lens))
+        finally:
+            if ctl_router is not None:
+                ctl_router.close(stop_replicas=True)
+            else:
+                ctl.close(stop_replicas=True)
+
+        cp99, sp99 = p99(calm_gaps), p99(storm_gaps)
+        ucp99, up99 = p99(ctl_calm_gaps), p99(ctl_gaps)
+        sp99_ms = round(sp99 * 1e3, 1) if sp99 else None
+        return {
+            "value": sp99_ms,
+            "unit": "decode_inter_token_p99_ms_under_prefill_storm",
+            "replicas": {"m1": {"prefill": 1, "decode": 2},
+                         "m2": {"unified": 1}, "control_unified": 3},
+            "calm_streams": n_calm,
+            "storm_streams": n_storm,
+            "calm_p99_ms": round(cp99 * 1e3, 1) if cp99 else None,
+            "storm_p99_ms": sp99_ms,
+            "unified_calm_p99_ms":
+                round(ucp99 * 1e3, 1) if ucp99 else None,
+            "unified_storm_p99_ms":
+                round(up99 * 1e3, 1) if up99 else None,
+            "handoffs_storm": handoffs_storm,
+            "handoff_bytes": final["handoff_bytes"],
+            "handoff_failures": final["handoff_failures"],
+            "fallbacks": final["fallbacks"],
+            "m2_requests": n_m2,
+            "m2_prefill_tokens": m2_prefill,
+            "m2_prefill_expected": m2_expected,
+            "stream_failures":
+                len(storm_errors) + len(kill_errors) + len(m2_errors),
+            "failure_sample":
+                (storm_errors + kill_errors + m2_errors)[:3],
+            "gate_decode_p99_bounded":
+                bool(cp99 and sp99 and sp99 <= 1.5 * cp99),
+            "gate_handoff_per_storm_prompt":
+                handoffs_storm >= n_storm,
+            "gate_zero_cross_model_errors":
+                not m2_errors and m2_prefill == m2_expected,
+            "gate_zero_handoff_failures":
+                not (storm_errors or kill_errors),
+            "gate_unified_control_degrades":
+                bool(ucp99 and up99 and up99 > 1.5 * ucp99),
+            "gate_metrics_scraped": scraped,
+        }
+    finally:
+        if router is not None:
+            router.close(stop_replicas=True)
+        else:
+            fleet.close(stop_replicas=True)
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_slo_tiers():
     """SLO tiers drill (docs/SERVING.md "Priority tiers"): saturate a
+    fleet's decode slots with batch-tier /generate streams, then run
+    interactive requests through the flood. Interactive latency must
+    hold (preemption evicts batch slots past the fair share), and the
+    preempted batch work must be LOSSLESS: the router's durable-stream
+    resume re-admits each preempted row, so every batch stream still
+    delivers its full token budget gapless, duplicate-free, and
     fleet's decode slots with batch-tier /generate streams, then run
     interactive requests through the flood. Interactive latency must
     hold (preemption evicts batch slots past the fair share), and the
@@ -3518,6 +3840,7 @@ CONFIGS = {
     "warmup": bench_warmup,
     "stream_failover": bench_stream_failover,
     "fleet_prefix": bench_fleet_prefix,
+    "disagg": bench_disagg,
     "slo_tiers": bench_slo_tiers,
     "train_elastic": bench_train_elastic,
     "controlplane": bench_controlplane,
@@ -3545,6 +3868,7 @@ METRIC_NAMES = {
     "warmup": "serving_warm_boot_warmup_speedup",
     "stream_failover": "serving_stream_failover_p99_ttnt_ms",
     "fleet_prefix": "fleet_prefix_prefill_token_reduction",
+    "disagg": "serving_disagg_decode_p99_under_prefill_storm_ms",
     "slo_tiers": "serving_interactive_p99_under_batch_flood_ms",
     "train_elastic": "train_elastic_kill_recovery_s",
     "controlplane": "controlplane_router_restart_recovery_s",

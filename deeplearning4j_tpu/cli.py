@@ -488,7 +488,8 @@ def cmd_serve(args) -> int:
             draft_window=args.draft_window,
             warmup_shape=(n_in,) if (args.warmup and n_in) else None,
             warmup_async=args.warmup_async,
-            warmup_plan=args.warmup_plan)
+            warmup_plan=args.warmup_plan,
+            role=args.role, model_id=args.model_id)
     except BaseException:
         tele.close()
         raise
@@ -499,6 +500,8 @@ def cmd_serve(args) -> int:
     # page_size/... stay for older log parsers)
     loop = gen.decode_loop if gen is not None else None
     print(json.dumps({"serving": handle.url,
+                      "role": args.role,
+                      "model_id": args.model_id,
                       "replicas": len(handle.replicas.engines),
                       "max_batch_size": args.max_batch_size,
                       "max_delay_ms": args.max_delay_ms,
@@ -554,22 +557,71 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def _parse_roles(spec: str) -> dict:
+    """`prefill=1,decode=2` -> {"prefill": 1, "decode": 2}."""
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, n = part.partition("=")
+        name = name.strip()
+        if name not in ("prefill", "decode", "unified"):
+            raise ValueError(
+                f"--roles: unknown role {name!r} (expected "
+                "prefill/decode/unified)")
+        out[name] = int(n or 1)
+        if out[name] < 0:
+            raise ValueError(f"--roles: {name} count must be >= 0")
+    return out
+
+
+def _parse_models(spec: str) -> dict:
+    """`tiny=conf.json,big=ckpt/` -> {"tiny": "conf.json", ...}."""
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, path = part.partition("=")
+        if not name.strip() or not path.strip():
+            raise ValueError(
+                f"--models: need NAME=PATH, got {part!r}")
+        out[name.strip()] = path.strip()
+    return out
+
+
 def cmd_fleet(args) -> int:
     """`fleet`: spawn N local replica server processes (and/or attach
     running ones by URL) behind the router tier — health-based
     eviction/rejoin, least-loaded routing with retries, load shedding,
-    rolling `POST /reload`, `POST /scale` (docs/FLEET.md)."""
+    rolling `POST /reload`, `POST /scale` (docs/FLEET.md). `--roles`
+    and/or `--models` replace the flat --replicas spawn with
+    per-(model, role) pools: each pool's replicas get the matching
+    `--role`/`--model-id` serve flags and autoscale independently."""
     from deeplearning4j_tpu.serving.fleet import (Autoscaler, Fleet,
                                                   ReplicaSpawner)
     from deeplearning4j_tpu.serving.router import (ReplicaClient,
                                                    serve_fleet)
 
-    if not args.attach and (not args.model or args.replicas < 1):
-        print("fleet needs -m MODEL with --replicas >= 1, and/or "
-              "--attach URL", file=sys.stderr)
+    try:
+        roles = _parse_roles(args.roles) if args.roles else {}
+        models = _parse_models(args.models) if args.models else {}
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    pooled = bool(roles or models)
+    if pooled and not models and not args.model:
+        print("fleet --roles needs -m MODEL (or --models)",
+              file=sys.stderr)
+        return 2
+    if not pooled and not args.attach \
+            and (not args.model or args.replicas < 1):
+        print("fleet needs -m MODEL with --replicas >= 1, --roles/"
+              "--models, and/or --attach URL", file=sys.stderr)
         return 2
     autoscaler = None
-    if args.autoscale:
+    if args.autoscale and not pooled:
         lo, _, hi = args.autoscale.partition(":")
         autoscaler = Autoscaler(min_replicas=int(lo),
                                 max_replicas=int(hi or lo))
@@ -579,7 +631,8 @@ def cmd_fleet(args) -> int:
         getattr(args, "compile_cache", None),
         args.model if args.model and os.path.isdir(args.model) else None)
     spawner = None
-    if args.model and (args.replicas > 0 or autoscaler is not None):
+    if not pooled and args.model \
+            and (args.replicas > 0 or autoscaler is not None):
         # the fleet's KV mode leads the spawned replicas' serve args so
         # an explicit --serve-arg from the operator still wins (later
         # argparse occurrence overrides)
@@ -614,7 +667,42 @@ def cmd_fleet(args) -> int:
         for url in args.attach:
             if ReplicaClient(url).url not in attached:
                 fleet.attach(url)
-        if spawner is not None and args.replicas > 0:
+        if pooled:
+            # per-(model, role) pools: each gets its own spawner whose
+            # serve_args bake in the matching --role/--model-id, its
+            # own autoscaler bounds, and spawns only the gap the
+            # re-adopted warm world leaves (matched by announced
+            # identity — journal adoption works per pool too)
+            model_pools = models or {"default": args.model}
+            role_layout = roles or {"unified": args.replicas}
+            reps = fleet.snapshot()["replicas"]
+            for mname, mpath in model_pools.items():
+                for rname, want in role_layout.items():
+                    sargs = ["--fleet-kv", args.fleet_kv]
+                    if rname != "unified":
+                        sargs += ["--role", rname]
+                    if models:
+                        sargs += ["--model-id", mname]
+                    sargs += args.serve_arg
+                    pool_scaler = None
+                    if args.autoscale:
+                        lo, _, hi = args.autoscale.partition(":")
+                        pool_scaler = Autoscaler(
+                            min_replicas=int(lo),
+                            max_replicas=int(hi or lo))
+                    fleet.add_pool(
+                        model_id=mname, role=rname,
+                        spawner=ReplicaSpawner(mpath,
+                                               serve_args=sargs),
+                        autoscaler=pool_scaler)
+                    have = sum(
+                        1 for r in reps.values()
+                        if r["state"] != "evicted"
+                        and (r.get("role") or "unified") == rname
+                        and (r.get("model_id") or "default") == mname)
+                    if want > have:
+                        fleet.spawn_pool(mname, rname, want - have)
+        elif spawner is not None and args.replicas > 0:
             # --replicas counts LOCAL processes: only spawned members
             # (the adopted warm world) fill the quota — attached URLs
             # are additive, exactly as on a fresh start
@@ -638,6 +726,7 @@ def cmd_fleet(args) -> int:
     # thread may be autoscale-spawning concurrently
     print(json.dumps({"router": handle.url,
                       "replicas": fleet.state_counts(),
+                      "roles": fleet.role_counts(),
                       "incarnation": fleet.incarnation,
                       "adopted": sum(1 for e in fleet.adoption_events
                                      if e["kind"] in ("adopted",
@@ -1364,6 +1453,19 @@ def build_parser() -> argparse.ArgumentParser:
                               " and to record at shutdown; `auto` "
                               "stores it inside the compile cache, "
                               "`off` disables plan replay/recording")
+    p_serve.add_argument("--role", default="unified",
+                         choices=("unified", "prefill", "decode"),
+                         help="disaggregated replica role announced on "
+                              "/readyz: `prefill` computes prompt KV "
+                              "and ships pages (never owns a stream), "
+                              "`decode` owns streams; `unified` does "
+                              "both (the default single-role fleet) "
+                              "(docs/FLEET.md \"Disaggregated roles\")")
+    p_serve.add_argument("--model-id", default=None, metavar="NAME",
+                         help="model identity announced on /readyz for "
+                              "multi-model fleet routing (requests "
+                              "carry X-Model / \"model_id\"); unset "
+                              "announces none and routes as `default`")
     p_serve.add_argument("--smoke", action="store_true",
                          help="start, print the address, shut down")
     telemetry_flags(p_serve)
@@ -1455,6 +1557,23 @@ def build_parser() -> argparse.ArgumentParser:
                               "autoscale spin-ups boot warm "
                               "(docs/WARMUP.md); `auto` co-locates "
                               "with a model/checkpoint DIR")
+    p_fleet.add_argument("--roles", default=None,
+                         metavar="ROLE=N[,ROLE=N...]",
+                         help="disaggregated role pools to spawn, e.g. "
+                              "`prefill=1,decode=2`: each pool's "
+                              "replicas get the matching `--role` "
+                              "serve flag and are autoscaled "
+                              "independently (docs/FLEET.md "
+                              "\"Disaggregated roles\"). Replaces "
+                              "--replicas for spawning")
+    p_fleet.add_argument("--models", default=None,
+                         metavar="NAME=PATH[,NAME=PATH...]",
+                         help="multi-model fleet: spawn one pool per "
+                              "named model (each replica serves PATH "
+                              "and announces `--model-id NAME`); "
+                              "combined with --roles every model gets "
+                              "the full role layout. Requests route by "
+                              "X-Model / \"model_id\"")
     p_fleet.add_argument("--smoke", action="store_true",
                          help="start, print the address, shut down "
                               "(stops spawned replicas)")

@@ -54,11 +54,22 @@ log = logging.getLogger(__name__)
 PLAN_VERSION = 1
 
 
-def auto_plan_path(cache_root: str, cache_key: str) -> str:
+def auto_plan_path(cache_root: str, cache_key: str,
+                   role: Optional[str] = None) -> str:
     """Where `warmup_plan="auto"` records/finds the plan for an engine
-    identity: co-located in the cache dir, keyed like the programs."""
+    identity: co-located in the cache dir, keyed like the programs.
+
+    `role` scopes the plan to a disaggregated replica role
+    (docs/FLEET.md "Disaggregated roles"): a prefill replica's plan
+    records only the prefill lanes and a decode replica's only the
+    decode ladder, so neither warms the other's programs. The
+    unified/None role keeps the legacy digest — existing plans stay
+    valid across the upgrade."""
+    key = cache_key
+    if role and role != "unified":
+        key = f"{cache_key}|role={role}"
     return os.path.join(os.path.abspath(cache_root), "plans",
-                        key_digest(cache_key) + ".json")
+                        key_digest(key) + ".json")
 
 
 def save_plan(path: str, plan: Dict[str, Any]) -> bool:

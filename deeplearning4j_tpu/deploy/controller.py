@@ -116,7 +116,8 @@ class DeploymentController:
                  state_dir: Optional[str] = None,
                  name: Optional[str] = None,
                  status_port: Optional[int] = None,
-                 request_timeout: float = 120.0):
+                 request_timeout: float = 120.0,
+                 model_id: Optional[str] = None):
         if (fleet is None) == (fleet_url is None):
             raise ValueError(
                 "DeploymentController needs exactly one of fleet= "
@@ -142,6 +143,10 @@ class DeploymentController:
         self.poll_interval = float(poll_interval)
         self.probe = probe
         self.request_timeout = float(request_timeout)
+        #: scope every reload this conveyor drives to ONE model's
+        #: replicas on a multi-model fleet (docs/FLEET.md
+        #: "Disaggregated roles"); None drives the whole fleet
+        self.model_id = model_id
         self.name = name if name is not None else f"p{next(_name_seq)}"
 
         self.phase = IDLE
@@ -425,7 +430,7 @@ class DeploymentController:
                     path, step=step,
                     rollback_path=champ.get("path"),
                     rollback_step=champ.get("step"),
-                    probe=self.probe)
+                    probe=self.probe, model_id=self.model_id)
                 return res, True
             except (NoReadyReplicas, OverloadedError) as e:
                 return {"reloaded": False, "error": str(e)}, False
@@ -436,6 +441,8 @@ class DeploymentController:
                    "rollback_path": champ.get("path"),
                    "rollback_step": champ.get("step"),
                    "probe": self.probe}
+        if self.model_id is not None:
+            payload["model_id"] = self.model_id
         req = urllib.request.Request(
             self.fleet_url + "/reload",
             data=json.dumps(payload).encode(),

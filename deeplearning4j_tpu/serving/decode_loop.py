@@ -177,10 +177,23 @@ from deeplearning4j_tpu.serving.speculation import build_drafter
 from deeplearning4j_tpu.testing import chaos
 from deeplearning4j_tpu.utils.jitcache import jit_cache_size
 
-__all__ = ["GenerationStream", "DecodeLoop"]
+__all__ = ["GenerationStream", "DecodeLoop", "ROLES", "ROLE_UNIFIED",
+           "ROLE_PREFILL", "ROLE_DECODE"]
 
 _DONE = object()
 _loop_seq = itertools.count()
+
+#: replica roles (docs/FLEET.md "Disaggregated roles"): a `unified`
+#: loop serves prefill AND decode (the default — existing deployments
+#: are unchanged); a `prefill` loop only computes prompt KV into its
+#: trie for `/kv/export` handoff (submit/generate are refused, so its
+#: compiled surface never grows a decode program); a `decode` loop is
+#: a unified loop the fleet routes streams at — the tag exists so the
+#: router/fleet can place work, not to change loop behavior.
+ROLE_UNIFIED = "unified"
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLES = (ROLE_UNIFIED, ROLE_PREFILL, ROLE_DECODE)
 
 #: per-queued-item service estimate feeding the backlog-derived
 #: Retry-After on a tier shed: interactive items are short user turns,
@@ -355,10 +368,14 @@ class DecodeLoop:
                  draft_window: int = 32, ngram: int = 3,
                  batch_share: float = 0.5,
                  batch_max_waiting: Optional[int] = None,
+                 role: str = ROLE_UNIFIED,
                  start: bool = True, name: Optional[str] = None):
         import jax
         import jax.numpy as jnp
 
+        if role not in ROLES:
+            raise ValueError(
+                f"role must be one of {ROLES}, got {role!r}")
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if horizon < 1:
@@ -383,6 +400,7 @@ class DecodeLoop:
                 f"got {batch_max_waiting}")
         self.cfg = cfg
         self.params = params
+        self.role = role
         self.slots = int(slots)
         self.page_size = int(page_size)
         self.horizon = int(horizon)
@@ -461,6 +479,13 @@ class DecodeLoop:
                 f"got {fleet_kv!r}")
         self.fleet_kv = (fleet_kv if self.prefix_cache_enabled
                          else fleetkv.MODE_OFF)
+        if self.role == ROLE_PREFILL and self.fleet_kv != fleetkv.MODE_ON:
+            # the trie + /kv/export wire ARE a prefill replica's whole
+            # product: without them it could never hand pages to anyone
+            raise ValueError(
+                "a prefill-role loop needs prefix_cache=True and "
+                "fleet_kv='on' — its only output is cached KV pages "
+                "shipped over /kv/export")
         #: install jobs queued for the scheduler thread — pool swaps
         #: happen OUTSIDE the lock on that thread, so a shipped-page
         #: scatter from a handler thread would race a prefill's swap;
@@ -823,6 +848,14 @@ class DecodeLoop:
         carry the shed tier plus a Retry-After derived from that
         tier's backlog, so a bulk client backs off proportionally to
         the lane it actually waits in."""
+        if self.role == ROLE_PREFILL:
+            # a prefill replica owns no streams: its compiled surface
+            # must never grow the decode/verify ladder (role-scoped
+            # warmup plans pin key-set disjointness on exactly this)
+            raise ValueError(
+                "this replica has role 'prefill' — it computes prompt "
+                "KV for handoff (/prefill) and serves /kv/export; "
+                "generate streams belong on a decode/unified replica")
         if tier not in TIERS:
             raise ValueError(
                 f"unknown tier {tier!r} (expected one of {TIERS})")
@@ -1021,6 +1054,7 @@ class DecodeLoop:
         traffic-dependent."""
         frag = {
             "cache_key": self.cache_key,
+            "role": self.role,
             "step": self._plan_step,
             "verify": self._plan_verify,
             "copy": self._plan_copy,
@@ -1100,6 +1134,7 @@ class DecodeLoop:
             return {
                 "v": 1,
                 "mode": self.fleet_kv,
+                "role": self.role,
                 "page_size": self.page_size,
                 "heads": fleetkv.summary_heads(self._prefix,
                                                self.page_size),
@@ -1207,21 +1242,149 @@ class DecodeLoop:
         scheduler — apply inline."""
         job = {"tokens": list(tokens), "chunks": chunks,
                "event": threading.Event(), "result": {}}
-        if self.alive:
-            with self._cond:
-                if self._closed:
-                    return 0
-                self._kv_jobs.append(job)
-                self._cond.notify_all()
-            if not job["event"].wait(timeout=max(1.0, float(timeout))):
-                raise fleetkv.ShipError(
-                    "install did not complete within the ship budget")
-        else:
-            self._run_kv_job(job)
+        self._enqueue_kv_job(job, timeout, "install did not complete "
+                                           "within the ship budget")
         err = job["result"].get("error")
         if err is not None:
             raise err
         return int(job["result"].get("installed", 0))
+
+    def _enqueue_kv_job(self, job: dict, timeout: float,
+                        expiry_msg: str) -> None:
+        """Route one pool-mutating job through the scheduler thread
+        (or run it inline in manual/test mode) and wait it out."""
+        if self.alive:
+            with self._cond:
+                if self._closed:
+                    job["result"]["error"] = RuntimeError(
+                        "decode loop is closed")
+                    return
+                self._kv_jobs.append(job)
+                self._cond.notify_all()
+            if not job["event"].wait(timeout=max(1.0, float(timeout))):
+                job["result"].setdefault(
+                    "error", fleetkv.ShipError(expiry_msg))
+        else:
+            self._run_kv_job(job)
+
+    # ---- disaggregated prefill (docs/FLEET.md "Disaggregated roles")
+    def prefill_only(self, tokens: Sequence[int],
+                     timeout: Optional[float] = None) -> dict:
+        """Handoff source: compute KV for `tokens`' FULL page-aligned
+        head chunks into this replica's own pool and adopt the pages
+        into the prefix trie as cached (refcount-zero, trie-retained)
+        pages — exactly where `/kv/export` reads from — WITHOUT ever
+        starting a stream. This is the whole job of a `prefill`-role
+        replica: the router POSTs `/prefill` here, then names this
+        replica as the `kv_donor` on the decode replica that owns the
+        stream, whose existing `kv_ship` pulls the pages. No decode
+        step, verify, or copy program is ever compiled by this path
+        (role-scoped warmup plans pin that), and a fully-covered head
+        is a cheap no-op — re-prefilling an already-hot prompt costs
+        one trie match. Raises on pool pressure / chaos faults; the
+        router treats ANY error as a failed handoff and falls back to
+        plain unified prefill on the decode replica (bit-identical by
+        the same causality argument the prefix cache rests on).
+        Returns {"chunks", "covered", "cached", "kv_bytes"}."""
+        if self._prefix is None:
+            raise ValueError(
+                "prefill_only needs the prefix cache: the trie is "
+                "where handoff pages live until /kv/export ships them")
+        n_full = len(tokens) // self.page_size
+        if n_full == 0:
+            # sub-page prompts have no trie key — nothing to hand off
+            return {"chunks": 0, "covered": 0, "cached": 0,
+                    "kv_bytes": 0}
+        job = {"kind": "prefill", "tokens": [int(t) for t in tokens],
+               "event": threading.Event(), "result": {}}
+        if timeout is None:
+            timeout = max(30.0, self.kv_ship_timeout)
+        self._enqueue_kv_job(job, timeout, "prefill handoff did not "
+                                           "complete within its budget")
+        err = job["result"].get("error")
+        if err is not None:
+            raise err
+        return job["result"]["report"]
+
+    def _apply_prefill_only(self, tokens) -> dict:
+        """Scheduler-thread half of `prefill_only`: pin the already-
+        cached head run, allocate pages for the uncovered chunks, run
+        the SAME bucketed prefill programs admission uses (bb=1 —
+        recorded in the warmup plan like any other group), adopt the
+        pages into the trie, release every pin. Mirrors
+        `_kv_apply_install`'s pin/alloc/adopt/release discipline so
+        the three-way page invariant holds at every exit."""
+        import jax.numpy as jnp
+
+        ps = self.page_size
+        head = [int(t) for t in tokens[:(len(tokens) // ps) * ps]]
+        n_full = len(head) // ps
+        # chaos: a handoff fault on the EXPORT side — the router sees
+        # the /prefill error, counts a failed handoff, and the stream
+        # proceeds with plain prefill on its decode replica
+        chaos.hit("disagg.handoff", role="export", chunks=n_full)
+        with self._cond:
+            matched = self._prefix.match(head)
+            covered = len(matched)
+            need = n_full - covered
+            page_bytes = paged_kv_bytes(self.cfg, 1, self.page_size)
+            if need <= 0:
+                return {"chunks": n_full, "covered": covered,
+                        "cached": 0, "kv_bytes": n_full * page_bytes}
+            for page in matched:
+                self._ref[page] += 1
+            fresh: List[int] = []
+            if self._avail_pages() >= need:
+                for _ in range(need):
+                    page = self._alloc_page()
+                    if page is None:  # pragma: no cover — availability
+                        break         # was checked above
+                    fresh.append(page)
+        try:
+            if len(fresh) < need:
+                raise OverloadedError(
+                    f"prefill handoff needs {need} pages but the pool "
+                    f"has no headroom "
+                    f"({len(self._free)}/{self.n_pages} free)",
+                    retry_after_ms=1000)
+            cov_tok = covered * ps
+            tl = len(head) - cov_tok
+            tb = next(b for b in self._buckets if b >= tl)
+            padded = np.zeros((1, tb), np.int32)
+            padded[0, :tl] = head[cov_tok:]
+            lens = np.full((1,), tl, np.int32)
+            pids = np.full((1, tb // ps), self._trash, np.int32)
+            pids[0, :len(fresh)] = fresh
+            if covered == 0:
+                self._plan_prefill.add((1, tb))
+                _first, self._pool = self._prefill(
+                    self.params, jnp.asarray(padded), jnp.asarray(lens),
+                    self._pool, jnp.asarray(pids))
+            else:
+                cb = 1
+                while cb < covered:
+                    cb *= 2
+                cb = min(cb, self._pps)
+                ctab = np.full((1, cb), self._trash, np.int32)
+                ctab[0, :covered] = matched
+                clen = np.full((1,), cov_tok, np.int32)
+                self._plan_prefill_ctx.add((1, cb, tb))
+                _first, self._pool = self._prefill_ctx(
+                    self.params, jnp.asarray(padded), jnp.asarray(lens),
+                    self._pool, jnp.asarray(pids), jnp.asarray(ctab),
+                    jnp.asarray(clen))
+            self._prefill_token_count += tl
+            with self._cond:
+                adopted = self._prefix.insert(head, matched + fresh)
+                self._ship_stats["prefill_handoffs"] = (
+                    self._ship_stats.get("prefill_handoffs", 0) + 1)
+            return {"chunks": n_full, "covered": covered,
+                    "cached": adopted, "kv_bytes": n_full * page_bytes}
+        finally:
+            with self._cond:
+                for page in matched + fresh:
+                    self._release_page(page)
+                self._cond.notify_all()
 
     def _service_kv_jobs(self) -> None:
         """Scheduler-thread drain of queued shipped-page installs —
@@ -1236,8 +1399,12 @@ class DecodeLoop:
 
     def _run_kv_job(self, job: dict) -> None:
         try:
-            job["result"]["installed"] = self._kv_apply_install(
-                job["tokens"], job["chunks"])
+            if job.get("kind") == "prefill":
+                job["result"]["report"] = self._apply_prefill_only(
+                    job["tokens"])
+            else:
+                job["result"]["installed"] = self._kv_apply_install(
+                    job["tokens"], job["chunks"])
         except Exception as e:
             job["result"]["error"] = e
         finally:
@@ -1297,6 +1464,7 @@ class DecodeLoop:
     def snapshot(self) -> dict:
         with self._cond:
             return {
+                "role": self.role,
                 "slots": self.slots,
                 "occupied_slots": self.occupied_slots,
                 "queued": len(self._waiting),

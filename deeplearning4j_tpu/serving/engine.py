@@ -353,7 +353,8 @@ class InferenceEngine:
                           draft_params=None, draft_cfg=None,
                           draft_window: int = 32,
                           batch_share: float = 0.5,
-                          batch_max_waiting: Optional[int] = None):
+                          batch_max_waiting: Optional[int] = None,
+                          role: str = "unified"):
         """Start the continuous-batching slot scheduler
         (serving/decode_loop.py) for this transformer engine: S slots
         over a paged KV pool riding ONE compiled decode step. `/generate`
@@ -388,7 +389,8 @@ class InferenceEngine:
                                       draft_cfg=draft_cfg,
                                       draft_window=draft_window,
                                       batch_share=batch_share,
-                                      batch_max_waiting=batch_max_waiting)
+                                      batch_max_waiting=batch_max_waiting,
+                                      role=role)
         return self.decode_loop
 
     def generate_stream(self, prompt, max_tokens: int,

@@ -268,6 +268,24 @@ class FleetReplica:
         self.admitted_at: Optional[float] = None
         self.evicted_at: Optional[float] = None
         self.eviction_reason: Optional[str] = None
+        #: (model_id, role) pool this replica was spawned INTO —
+        #: pool-scoped autoscaling attributes a STARTING member (no
+        #: /readyz payload yet, so no announced identity) to the pool
+        #: that spawned it instead of the default pool
+        self.pool: Optional[Tuple[str, str]] = None
+
+    @property
+    def role(self) -> str:
+        """Replica role announced in its last /readyz payload
+        (docs/FLEET.md "Disaggregated roles"). "unified" until the
+        first probe — a never-probed replica routes the legacy way."""
+        return (self.last_ready or {}).get("role") or "unified"
+
+    @property
+    def model_id(self) -> Optional[str]:
+        """Model this replica announced (None = single-model legacy;
+        consumers normalize None to "default")."""
+        return (self.last_ready or {}).get("model_id")
 
     def snapshot(self, now: Optional[float] = None) -> dict:
         now = now if now is not None else time.time()
@@ -278,6 +296,9 @@ class FleetReplica:
                # or None), from its last /readyz payload — the per-
                # replica identity the torn-promotion check aggregates
                "checkpoint": (self.last_ready or {}).get("checkpoint"),
+               # disaggregated placement identity, from the same probe
+               "role": self.role,
+               "model_id": self.model_id,
                "breaker": self.breaker.snapshot()}
         if self.adopted:
             out["adopted"] = True
@@ -530,6 +551,20 @@ class Fleet:
         #: later capacity repair. None = never promoted: boot-time
         #: heterogeneity is the operator's business, not ours.
         self.current_step: Optional[int] = None
+        #: multi-model twin of current_checkpoint/current_step:
+        #: model_id -> (path, step) pinned by a model-scoped
+        #: rolling_reload. Newcomers announcing that model converge
+        #: onto THIS identity before admission (docs/FLEET.md
+        #: "Disaggregated roles" — one router, N models)
+        self.model_checkpoints: Dict[str, Tuple[str, Optional[int]]] = {}
+        #: (model_id, role) -> {"spawner", "autoscaler"} replica pools
+        #: for pool-scoped autoscaling (add_pool); empty = the legacy
+        #: single-pool fleet-level autoscaler signal
+        self._pools: Dict[Tuple[str, str], dict] = {}
+        #: (role, model) gauge children registered so far — roles and
+        #: models are DISCOVERED from /readyz payloads, so the
+        #: dl4j_fleet_role_replicas series appear at first sight
+        self._role_gauge_keys: set = set()
         # the scaleout control-plane tracker IS the health store:
         # heartbeat() on probe success (re-registers evicted members),
         # stale_workers() drives eviction — runtime._evict_stale's idiom
@@ -622,6 +657,27 @@ class Fleet:
             "replayed tokens the router suppressed by absolute "
             "token_index so the client stream stays exactly-once "
             "across failover").labels(**lab)
+        self._m_disagg_handoffs = reg.counter(
+            "dl4j_disagg_handoffs",
+            "prefill->decode handoffs dispatched: the router drove "
+            "/prefill on a prefill-role replica and named it as the "
+            "kv_donor of the decode placement").labels(**lab)
+        self._m_disagg_handoff_bytes = reg.counter(
+            "dl4j_disagg_handoff_bytes",
+            "KV page bytes made shippable by prefill handoffs (as "
+            "reported by the prefill replica's /prefill reply)").labels(
+                **lab)
+        self._m_disagg_handoff_failures = reg.counter(
+            "dl4j_disagg_handoff_failures",
+            "prefill handoff dispatches that errored (dead prefill "
+            "replica, shed, chaos) — each one degrades the stream to "
+            "plain unified prefill, never to a failed request").labels(
+                **lab)
+        self._m_disagg_fallbacks = reg.counter(
+            "dl4j_disagg_fallbacks",
+            "streams that proceeded with plain prefill after a failed "
+            "or skipped handoff on a fleet that HAS prefill "
+            "capacity").labels(**lab)
         tscope = {"scope": f"fleet:{self.label}"}
         self._m_tier_requests = {
             t: reg.counter(
@@ -849,6 +905,9 @@ class Fleet:
                 "incarnation": self.incarnation,
                 "current_checkpoint": self.current_checkpoint,
                 "current_step": self.current_step,
+                "model_checkpoints": {
+                    m: list(v)
+                    for m, v in self.model_checkpoints.items()},
                 "replicas": replicas,
                 "written_at": time.time(),
             }
@@ -868,6 +927,11 @@ class Fleet:
         if self.current_checkpoint is None:
             self.current_checkpoint = prior.get("current_checkpoint")
             self.current_step = prior.get("current_step")
+        if not self.model_checkpoints:
+            self.model_checkpoints = {
+                m: (v[0], v[1]) for m, v in
+                (prior.get("model_checkpoints") or {}).items()
+                if isinstance(v, (list, tuple)) and len(v) == 2}
         max_rid = -1
         for rid, e in (prior.get("replicas") or {}).items():
             if rid.startswith("r"):
@@ -1011,7 +1075,8 @@ class Fleet:
                 rep = self._replicas.get(wid)
             if rep is not None and rep.state != EVICTED:
                 self._evict(rep, "heartbeat timeout")
-        if self.autoscaler is not None and self.spawner is not None:
+        if ((self.autoscaler is not None and self.spawner is not None)
+                or self._pools):
             self.autoscale_tick()
 
     def _probe(self, rep: FleetReplica) -> None:
@@ -1043,6 +1108,7 @@ class Fleet:
                     rep.breaker.reopen()
             return
         rep.last_ready = payload
+        self._ensure_role_gauge(rep.role, rep.model_id or "default")
         self._fold_kv_summary(rep, payload)
         if ready and rep.state in (STARTING, EVICTED):
             with self._lock:
@@ -1080,16 +1146,26 @@ class Fleet:
                 seen[key] = now
             rep.kv_seen = seen
 
-    def kv_summaries(self) -> dict:
+    def kv_summaries(self, model_id: Optional[str] = None) -> dict:
         """READY replicas' affinity summaries: {replica_id ->
         (kv_summary payload, url)}. The router's placement input
         (fleetkv.RouterAffinity.plan); replicas without a summary
         (plane off, pre-first-probe, summary chaos) simply don't
-        appear — affinity degrades, routing never blocks on it."""
+        appear — affinity degrades, routing never blocks on it.
+        Prefill-role replicas never appear either: they donate pages
+        through the explicit /prefill handoff, and an affinity prefer
+        pointing at one would route a stream to a replica that rejects
+        streams. `model_id` (when given) keeps model B's summaries
+        from placing model A's prompt."""
         with self._lock:
             out = {}
             for rid, rep in self._replicas.items():
                 if rep.state != READY:
+                    continue
+                if rep.role == "prefill":
+                    continue
+                if (model_id is not None
+                        and (rep.model_id or "default") != model_id):
                     continue
                 summary = (rep.last_ready or {}).get("kv_summary")
                 if isinstance(summary, dict):
@@ -1102,16 +1178,22 @@ class Fleet:
         (self._m_affinity_hits if hit
          else self._m_affinity_misses).inc()
 
-    def _prefix_section(self) -> dict:
+    def _prefix_section(self, model_id: Optional[str] = None) -> dict:
         """Fleet-wide prefix-cache view for /stats: each replica's
         last-reported hit/page figures plus the fleet totals and the
         router's affinity hit rate. Figures come from the same
         kv_summary the affinity plane rides on, so a replica whose
-        plane is off simply contributes zeros."""
+        plane is off simply contributes zeros. `model_id` narrows the
+        view to one model's replicas (the per-model /stats section);
+        the affinity rate is router-global, so it only appears on the
+        fleet-wide view."""
         per: Dict[str, dict] = {}
         hits = misses = pages = ships = 0
         with self._lock:
             for rid, rep in self._replicas.items():
+                if (model_id is not None
+                        and (rep.model_id or "default") != model_id):
+                    continue
                 summary = (rep.last_ready or {}).get("kv_summary")
                 if not isinstance(summary, dict):
                     continue
@@ -1126,61 +1208,78 @@ class Fleet:
                 misses += row["misses"]
                 pages += row["pages_cached"]
                 ships += row["page_ships"]
-        ahits = int(self._m_affinity_hits.value)
-        amisses = int(self._m_affinity_misses.value)
-        placed = ahits + amisses
-        return {
+        out = {
             "replicas": per,
             "hits": hits,
             "misses": misses,
             "pages_cached": pages,
             "page_ships": ships,
-            "ship_bytes": int(self._m_ship_bytes.value),
-            "ship_failures": int(self._m_ship_failures.value),
-            "affinity": {
+        }
+        if model_id is None:
+            ahits = int(self._m_affinity_hits.value)
+            amisses = int(self._m_affinity_misses.value)
+            placed = ahits + amisses
+            out["ship_bytes"] = int(self._m_ship_bytes.value)
+            out["ship_failures"] = int(self._m_ship_failures.value)
+            out["affinity"] = {
                 "hits": ahits,
                 "misses": amisses,
                 "rate": round(ahits / placed, 4) if placed else 0.0,
-            },
-        }
+            }
+        return out
+
+    def _converge_target(self, rep: FleetReplica
+                         ) -> Tuple[Optional[str], Optional[int]]:
+        """The checkpoint identity `rep` must serve to enter rotation:
+        its model's pinned (path, step) when a model-scoped
+        rolling_reload promoted one, else the fleet-wide pin."""
+        pinned = self.model_checkpoints.get(rep.model_id or "default")
+        if pinned is not None:
+            return pinned
+        return self.current_checkpoint, self.current_step
 
     def _needs_converge(self, rep: FleetReplica) -> bool:
-        """True when `rep` reports a checkpoint identity other than the
-        pinned current_checkpoint@current_step. Only armed once a
-        rolling_reload pinned a step: before any promotion the fleet
-        has no opinion on what its members serve."""
-        if self.current_step is None or self.current_checkpoint is None:
-            return False
+        """True when `rep` reports a checkpoint identity other than
+        its converge target. Only armed once a rolling_reload pinned
+        one (fleet-wide step, or the replica's model): before any
+        promotion the fleet has no opinion on what its members
+        serve."""
         if self._reload_active:
             return False  # rolling_reload is rewriting identity now
+        target, step = self._converge_target(rep)
+        if target is None:
+            return False
+        if (step is None
+                and (rep.model_id or "default")
+                not in self.model_checkpoints):
+            return False  # fleet-wide pin needs a step to be armed
         ck = (rep.last_ready or {}).get("checkpoint") or {}
         path = ck.get("path")
         return not (path
                     and os.path.abspath(path)
-                    == os.path.abspath(self.current_checkpoint)
-                    and ck.get("step") == self.current_step)
+                    == os.path.abspath(target)
+                    and ck.get("step") == step)
 
     def _admit(self, rep: FleetReplica) -> None:
         if self._needs_converge(rep):
             # a newcomer (capacity-gap spawn, readmitted eviction, late
-            # adoption) must not enter rotation serving anything but the
-            # promoted champion — THAT would be a torn promotion. Bring
-            # it to current_checkpoint@current_step first; on failure it
-            # stays out of rotation and the next monitor pass retries —
-            # dark beats stale.
+            # adoption) must not enter rotation serving anything but
+            # ITS MODEL's promoted champion — THAT would be a torn
+            # promotion. Bring it to the converge target first; on
+            # failure it stays out of rotation and the next monitor
+            # pass retries — dark beats stale.
+            target, tstep = self._converge_target(rep)
             ok, info = self._reload_one(
-                rep, self.current_checkpoint, self.current_step,
+                rep, target, tstep,
                 None, ready_timeout=max(30.0, self.request_timeout))
             if not ok:
                 log.warning(
                     "fleet %s: replica %s failed to converge onto "
                     "%s@%s (%s); held out of rotation", self.label,
-                    rep.id, self.current_checkpoint, self.current_step,
-                    info.get("error"))
+                    rep.id, target, tstep, info.get("error"))
                 return
             log.info("fleet %s: replica %s converged onto %s@%s before "
-                     "admission", self.label, rep.id,
-                     self.current_checkpoint, self.current_step)
+                     "admission", self.label, rep.id, target, tstep)
         with self._lock:
             was_evicted = rep.state == EVICTED
             rep.state = READY
@@ -1317,12 +1416,81 @@ class Fleet:
         with self._lock:
             return self._tier_inflight[TIER_BATCH]
 
+    # --------------------------------------- roles & models (disagg)
+    def _ensure_role_gauge(self, role: str, model: str) -> None:
+        """Register the dl4j_fleet_role_replicas{role=,model=} gauge
+        child at first sight of a (role, model) pair — the series are
+        discovered from /readyz payloads, never pre-declared."""
+        key = (role, model)
+        with self._lock:
+            if key in self._role_gauge_keys:
+                return
+            self._role_gauge_keys.add(key)
+        ref = weakref.ref(self)
+        telemetry.get_registry().gauge(
+            "dl4j_fleet_role_replicas",
+            "READY fleet replicas by disaggregated role and model "
+            '(docs/FLEET.md "Disaggregated roles")').labels(
+                role=role, model=model, fleet=self.label).set_function(
+            (lambda rl, m: lambda: (
+                (lambda o: o.role_model_count(rl, m) if o else 0)(
+                    ref())))(role, model))
+
+    def role_model_count(self, role: str, model: str) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas.values()
+                       if r.state == READY and r.role == role
+                       and (r.model_id or "default") == model)
+
+    def role_counts(self, model_id: Optional[str] = None
+                    ) -> Dict[str, int]:
+        """READY replicas by role (optionally one model's) — the
+        router's cheap "does this fleet have a prefill pool" check."""
+        with self._lock:
+            counts: Dict[str, int] = {}
+            for r in self._replicas.values():
+                if r.state != READY:
+                    continue
+                if (model_id is not None
+                        and (r.model_id or "default") != model_id):
+                    continue
+                counts[r.role] = counts.get(r.role, 0) + 1
+            return counts
+
+    @staticmethod
+    def _routable(rep: FleetReplica, role: Optional[str],
+                  model_id: Optional[str]) -> bool:
+        """Role/model admission filter (docs/FLEET.md "Disaggregated
+        roles"). `role=None` means a STREAM-capable replica — unified
+        or decode: a prefill-role replica never serves /predict or
+        /generate, so it is excluded unless explicitly requested with
+        role="prefill". Any non-prefill role is satisfied by a
+        unified replica (the default deployment IS the unified pool).
+        `model_id=None` skips model filtering (single-model fleets);
+        otherwise replicas that announce no model count as
+        "default"."""
+        rrole = rep.role
+        if role is None:
+            if rrole == "prefill":
+                return False
+        elif role == "prefill":
+            if rrole != "prefill":
+                return False
+        elif rrole not in (role, "unified"):
+            return False
+        if (model_id is not None
+                and (rep.model_id or "default") != model_id):
+            return False
+        return True
+
     def select(self, route: str = "predict",
                exclude: Sequence[str] = (),
                tier: str = TIER_INTERACTIVE,
                count: bool = True,
                prefer: Optional[str] = None,
-               prefer_slack: int = 4) -> FleetReplica:
+               prefer_slack: int = 4,
+               role: Optional[str] = None,
+               model_id: Optional[str] = None) -> FleetReplica:
         """Least-outstanding READY replica (round-robin tiebreak) —
         the ReplicaSet policy lifted across processes. SUSPECT
         replicas (recent request timeouts, breaker not yet open) stay
@@ -1351,7 +1519,16 @@ class Fleet:
         excluded, and within `prefer_slack` outstanding requests of
         the least-loaded candidate. Every shed above still fires
         first; when the preference loses, selection falls back to the
-        least-outstanding policy unchanged."""
+        least-outstanding policy unchanged.
+
+        `role`/`model_id` scope the candidate pool for a disaggregated
+        or multi-model fleet (`_routable`): the default role=None
+        excludes prefill-role replicas — a generate stream or predict
+        must NEVER land on one — and role="prefill" is how the router
+        dispatches the handoff's prefill leg. The `prefer` hint passes
+        through the same filter by construction (it is resolved inside
+        the filtered candidate set), so an affinity plan can never
+        override the role/model fence."""
         if tier not in TIERS:
             raise ValueError(
                 f"unknown tier {tier!r} (expected one of {TIERS})")
@@ -1368,10 +1545,14 @@ class Fleet:
             ids = list(self._replicas)
             ready = [r for r in self._replicas.values()
                      if r.state in (READY, SUSPECT)
-                     and r.id not in exclude]
+                     and r.id not in exclude
+                     and self._routable(r, role, model_id)]
             if not ready:
                 raise NoReadyReplicas(
-                    f"no ready replica (states: {self.state_counts()})")
+                    f"no ready replica for role="
+                    f"{role or 'unified/decode'} model="
+                    f"{model_id or 'any'} "
+                    f"(states: {self.state_counts()})")
             total = sum(r.outstanding
                         for r in self._replicas.values())
             if (tier == TIER_BATCH and self.batch_high_water is not None
@@ -1441,7 +1622,8 @@ class Fleet:
 
     def forward_predict(self, body: bytes,
                         deadline: Optional[Deadline] = None,
-                        tier: str = TIER_INTERACTIVE
+                        tier: str = TIER_INTERACTIVE,
+                        model_id: Optional[str] = None
                         ) -> Tuple[int, dict, bytes]:
         """Route one /predict: least-loaded replica, transparent retry
         on a healthy peer after connection failures, request timeouts,
@@ -1474,7 +1656,7 @@ class Fleet:
                     deadline.check("router retry")
                 try:
                     rep = self.select(route="predict", exclude=tried,
-                                      tier=tier)
+                                      tier=tier, model_id=model_id)
                 except NoReadyReplicas:
                     break  # fall through to best-effort answer below
                 if tried:
@@ -1608,7 +1790,8 @@ class Fleet:
                        rollback_step: Optional[int] = None,
                        probe: Optional[dict] = None,
                        drain_timeout: float = 30.0,
-                       ready_timeout: float = 120.0) -> dict:
+                       ready_timeout: float = 120.0,
+                       model_id: Optional[str] = None) -> dict:
         """Orchestrate `POST /reload` across the fleet with zero
         downtime: one replica at a time — drain (stop routing to it,
         wait out its in-flight requests), reload, `/readyz`-probe
@@ -1619,7 +1802,15 @@ class Fleet:
         checkpoint the fleet was serving) — the fleet never stays
         mixed. Requests in flight elsewhere are untouched throughout,
         and each replica's own swap is atomic, so no response ever
-        mixes old and new weights."""
+        mixes old and new weights.
+
+        `model_id` scopes the reload to ONE model's replicas in a
+        multi-model fleet (every role pool of that model; the others
+        keep serving untouched) and pins the promoted identity in
+        `model_checkpoints[model_id]` — the per-model convergence
+        target newcomers of that model must reach before admission.
+        The default rollback target is then that model's previously
+        pinned checkpoint, not the fleet-wide one."""
         if not self._reload_lock.acquire(blocking=False):
             raise OverloadedError(
                 "a rolling reload is already in progress",
@@ -1631,11 +1822,23 @@ class Fleet:
             # leave it serving the old checkpoint indefinitely
             with self._lock:
                 targets = [r for r in self._replicas.values()
-                           if r.state in (READY, SUSPECT)]
+                           if r.state in (READY, SUSPECT)
+                           and (model_id is None
+                                or (r.model_id or "default")
+                                == model_id)]
             if not targets:
-                raise NoReadyReplicas("no ready replicas to reload")
-            rollback = (rollback_path if rollback_path is not None
-                        else self.current_checkpoint)
+                raise NoReadyReplicas(
+                    "no ready replicas to reload"
+                    + (f" for model {model_id!r}" if model_id else ""))
+            if rollback_path is not None:
+                rollback = rollback_path
+            elif (model_id is not None
+                  and model_id in self.model_checkpoints):
+                rollback, pinned_step = self.model_checkpoints[model_id]
+                if rollback_step is None:
+                    rollback_step = pinned_step
+            else:
+                rollback = self.current_checkpoint
             done: List[str] = []
             for i, rep in enumerate(targets):
                 with self._lock:
@@ -1655,6 +1858,8 @@ class Fleet:
                     "drained": drained, "error": info,
                     "completed_before_failure": list(done),
                 }
+                if model_id is not None:
+                    result["model_id"] = model_id
                 to_roll = list(done)
                 if info.get("weights_changed"):
                     to_roll.append(rep.id)
@@ -1676,13 +1881,19 @@ class Fleet:
                            else "failed")
                 self._m_reloads[outcome].inc()
                 return result
-            self.current_checkpoint = path
-            self.current_step = step
+            if model_id is None:
+                self.current_checkpoint = path
+                self.current_step = step
+            else:
+                self.model_checkpoints[model_id] = (path, step)
             self._m_reloads["ok"].inc()
             self._journal_write()  # the serving checkpoint is journaled
             # state: a restarted router must know the rollback target
-            return {"reloaded": True, "path": path, "step": step,
-                    "replicas": done}
+            out = {"reloaded": True, "path": path, "step": step,
+                   "replicas": done}
+            if model_id is not None:
+                out["model_id"] = model_id
+            return out
         finally:
             self._reload_active = False
             self._reload_lock.release()
@@ -1734,12 +1945,106 @@ class Fleet:
             return False
 
     # ------------------------------------------------------ autoscaling
+    def add_pool(self, *, model_id: str = "default",
+                 role: str = "unified",
+                 spawner: Optional[ReplicaSpawner] = None,
+                 autoscaler: Optional[Autoscaler] = None) -> None:
+        """Register a (model, role) replica pool for pool-scoped
+        autoscaling (docs/FLEET.md "Disaggregated roles"):
+        `autoscale_tick` then sizes each registered pool independently
+        between ITS autoscaler's min/max using ITS spawner — whose
+        serve_args bake in the matching `--role`/`--model-id` — so
+        per-role AND per-model floors/ceilings hold on one fleet. With
+        no pools registered the legacy single-pool fleet-level signal
+        runs unchanged. `spawner=None` falls back to the fleet
+        spawner; `autoscaler=None` registers the pool for placement
+        bookkeeping only (spawn_pool still works)."""
+        with self._lock:
+            self._pools[(model_id, role)] = {
+                "spawner": (spawner if spawner is not None
+                            else self.spawner),
+                "autoscaler": autoscaler,
+            }
+
+    def spawn_pool(self, model_id: str, role: str,
+                   n: int = 1) -> List[FleetReplica]:
+        """Spawn n replicas into a registered (model, role) pool and
+        stamp their pool membership (STARTING members have no
+        announced identity yet — the stamp is what attributes them to
+        the right pool's autoscaler)."""
+        with self._lock:
+            pool = self._pools.get((model_id, role))
+        spawner = (pool or {}).get("spawner") or self.spawner
+        if spawner is None:
+            raise RuntimeError(
+                f"no spawner for pool ({model_id!r}, {role!r})")
+        out = []
+        for _ in range(n):
+            proc, url = spawner.spawn()
+            rep = self.attach(url, proc=proc, spawned=True)
+            rep.pool = (model_id, role)
+            out.append(rep)
+            self._m_spawned.inc()
+        return out
+
+    def _pool_members(self, model: str, role: str
+                      ) -> List[FleetReplica]:
+        """Non-evicted replicas belonging to a (model, role) pool: by
+        spawn stamp when present, else by announced identity (caller
+        holds the lock)."""
+        out = []
+        for r in self._replicas.values():
+            if r.state == EVICTED:
+                continue
+            if r.pool is not None:
+                if r.pool == (model, role):
+                    out.append(r)
+            elif (r.role == role
+                  and (r.model_id or "default") == model):
+                out.append(r)
+        return out
+
+    def _autoscale_pools(self) -> int:
+        """One pool-scoped autoscale pass: each registered pool's
+        queue-depth signal is computed over ITS members only, and
+        spawn/retire act through ITS spawner. Returns the net delta."""
+        applied = 0
+        with self._lock:
+            pools = list(self._pools.items())
+        for (model, role), pool in pools:
+            scaler = pool.get("autoscaler")
+            if scaler is None:
+                continue
+            with self._lock:
+                members = self._pool_members(model, role)
+                live = [r for r in members
+                        if r.state in (READY, SUSPECT, STARTING)]
+                outstanding = sum(r.outstanding for r in members)
+            delta = scaler.decide(len(live), outstanding)
+            if delta > 0:
+                self.spawn_pool(model, role, 1)
+                scaler.note_action()
+                applied += 1
+            elif delta < 0:
+                ready = [r for r in live
+                         if r.state == READY and r.spawned]
+                if ready:
+                    victim = min(ready, key=lambda r: r.outstanding)
+                    self.retire(victim.id)
+                    scaler.note_action()
+                    applied -= 1
+        return applied
+
     def autoscale_tick(self) -> int:
-        """Apply one autoscaler decision; returns the delta applied."""
-        if self.autoscaler is None or self.spawner is None:
-            return 0
+        """Apply one autoscaler decision; returns the delta applied.
+        With registered pools (add_pool) the pass is pool-scoped; the
+        legacy fleet-level signal runs otherwise."""
         if self._reload_active:
             return 0  # never resize mid-reload
+        if self._pools:
+            return self._autoscale_pools()
+        if self.autoscaler is None or self.spawner is None:
+            return 0
         with self._lock:
             live = [r for r in self._replicas.values()
                     if r.state in (READY, SUSPECT, STARTING)]
@@ -1777,15 +2082,37 @@ class Fleet:
         # off the router's /stats — a converged fleet shows exactly one
         # identity key across its READY replicas (docs/PIPELINE.md)
         served: Dict[str, list] = {}
+        # per-model aggregation (docs/FLEET.md "Disaggregated roles"):
+        # one router, N models — each model's role pools, served
+        # checkpoints, and prefix-cache view keyed by model_id (the
+        # multi-model /stats section the deployment controller and the
+        # cross-model isolation drill read)
+        models: Dict[str, dict] = {}
         for rid, r in sorted(reps.items()):
             if r.get("state") == EVICTED:
                 continue  # not serving: a stale identity is not "served"
             ck = r.get("checkpoint")
             key = (f"{ck.get('path')}@{ck.get('step')}" if ck else "none")
             served.setdefault(key, []).append(rid)
+            m = r.get("model_id") or "default"
+            sec = models.setdefault(
+                m, {"replicas": [], "roles": {},
+                    "checkpoints_served": {}})
+            sec["replicas"].append(rid)
+            ro = r.get("role") or "unified"
+            sec["roles"][ro] = sec["roles"].get(ro, 0) + 1
+            sec["checkpoints_served"].setdefault(key, []).append(rid)
+        for m, sec in models.items():
+            sec["prefix_cache"] = self._prefix_section(model_id=m)
+            pinned = self.model_checkpoints.get(m)
+            if pinned is not None:
+                sec["current_checkpoint"] = pinned[0]
+                sec["current_step"] = pinned[1]
         return {
             "replicas": reps,
             "checkpoints_served": served,
+            "roles": self.role_counts(),
+            "models": models,
             "states": self.state_counts(),
             "breakers": self.breaker_counts(),
             "outstanding": self.total_outstanding(),
@@ -1808,6 +2135,14 @@ class Fleet:
                 self._m_stream_tokens_replayed.value),
             "stream_tokens_deduped": int(
                 self._m_stream_tokens_deduped.value),
+            "disagg": {
+                "handoffs": int(self._m_disagg_handoffs.value),
+                "handoff_bytes": int(
+                    self._m_disagg_handoff_bytes.value),
+                "handoff_failures": int(
+                    self._m_disagg_handoff_failures.value),
+                "fallbacks": int(self._m_disagg_fallbacks.value),
+            },
             "request_timeouts": int(self._m_timeouts.value),
             "breaker_opens": int(self._m_breaker_opens.value),
             "deadline_exceeded": {route: int(c.value)

@@ -365,6 +365,16 @@ class RouterAffinity:
         request handler — the opt-out satellite's router half)."""
         if self.mode == MODE_OFF or not summaries:
             return None
+        # role filter (docs/FLEET.md "Disaggregated roles"): a
+        # prefill-role replica never runs a generate stream, so it
+        # must never become a prefer target, a donor hint, or a ring
+        # owner here — its pages reach the decode side through the
+        # explicit /prefill handoff, not through affinity placement
+        summaries = {rid: sv for rid, sv in summaries.items()
+                     if ((sv[0] or {}).get("role") or "unified")
+                     != "prefill"}
+        if not summaries:
+            return None
         page_sizes = {int((s or {}).get("page_size", 0))
                       for s, _url in summaries.values()}
         page_sizes.discard(0)

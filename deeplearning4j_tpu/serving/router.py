@@ -48,9 +48,26 @@ Two pieces live here (the fleet state machine itself is
     replica (the preempting replica is healthy). A shed re-admission
     (503: the batch lane is full) waits out the tier-aware
     ``Retry-After`` and tries again.
+    DISAGGREGATED fleets (docs/FLEET.md "Disaggregated roles") add a
+    prefill handoff in front of the durable stream: when the fleet
+    has READY prefill-role replicas, the router first drives
+    ``POST /prefill`` on the least-loaded one — parking the prompt's
+    full KV pages in that replica's prefix trie — and then names it
+    as the decode placement's ``kv_donor`` so the decode replica
+    pulls the pages peer-to-peer over ``/kv/export`` before its own
+    (now trivial) prefill. ANY failure along the handoff degrades
+    the stream to plain unified prefill, bit-identically (greedy
+    argmax decode from the same causal context).
+
+    MULTI-MODEL fleets route by model: an ``X-Model`` header (or a
+    ``"model_id"`` body field on /generate) scopes replica selection,
+    affinity placement, and the prefill handoff to replicas
+    announcing that model in /readyz; absent both, any
+    stream-capable replica serves (single-model fleets unchanged).
   - ``POST /reload``   — rolling/canary reload across the fleet
     (drain -> per-replica /reload -> /readyz probe -> readmit, one at
     a time; automatic rollback when the canary fails — Fleet.rolling_reload).
+    A ``"model_id"`` field scopes the reload to one model's replicas.
   - ``POST /scale``    — autoscaling hook: ``{"replicas": N}`` spawns
     or retires to N (requires a spawner).
   - ``GET /healthz``   — router liveness + per-state replica counts.
@@ -408,6 +425,21 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                 raise ValueError("request body must be a JSON object")
             return data
 
+        def _model_id(self, data: Optional[dict] = None
+                      ) -> Optional[str]:
+            """The request's model scope: `X-Model` header first (the
+            only channel /predict has — its body is forwarded raw),
+            then a `"model_id"` body field. None routes un-scoped
+            (any stream-capable replica — single-model fleets never
+            pay the filter)."""
+            mid = self.headers.get("X-Model")
+            if not mid and isinstance(data, dict):
+                mid = data.get("model_id")
+            if mid is None:
+                return None
+            mid = str(mid).strip()
+            return mid or None
+
         def _predict(self):
             if self._body is None:
                 raise ValueError("missing request body")
@@ -420,7 +452,8 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             # honored by the replica's own batcher)
             tier = parse_tier(self.headers)
             status, headers, data = fleet.forward_predict(
-                self._body, deadline=deadline, tier=tier)
+                self._body, deadline=deadline, tier=tier,
+                model_id=self._model_id())
             ctype = headers.get("Content-Type", "application/json")
             extra = [("Retry-After", headers["Retry-After"])] \
                 if "Retry-After" in headers else []
@@ -453,7 +486,8 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                                           fleet.probe_timeout)
             return hop_timeout, fwd_headers or None, eligible
 
-        def _kv_place(self, tokens, use_prefix: bool):
+        def _kv_place(self, tokens, use_prefix: bool,
+                      model_id: Optional[str] = None):
             """Prefix-affinity placement for one request, or None.
 
             The opt-out contract (docs/FLEET.md): a body carrying
@@ -462,13 +496,75 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             fingerprints of opted-out requests are never computed on
             the router, just as the replica never seeds its summary
             with them. A placement fault degrades to least-outstanding
-            routing, never to a failed request."""
+            routing, never to a failed request. `model_id` scopes the
+            summary set so cross-model prefixes never attract each
+            other's traffic."""
             if not use_prefix or not affinity.enabled:
                 return None
             try:
-                return affinity.plan(tokens, fleet.kv_summaries())
+                return affinity.plan(
+                    tokens, fleet.kv_summaries(model_id=model_id))
             except Exception:
                 return None
+
+        def _disagg_handoff(self, rows, deadline, tier,
+                            model_id, use_prefix: bool):
+            """The prefill leg of a disaggregated handoff: drive
+            /prefill on the least-loaded prefill-role replica so the
+            prompts' full KV pages are parked in ITS prefix trie,
+            then return its URL for the decode placement's
+            `kv_donor` hint (decode_loop.kv_ship pulls the pages
+            peer-to-peer before prefill). Returns None — plain
+            unified prefill, bit-identical — when the fleet has no
+            prefill pool for this model, shipping is off, the prompt
+            is shorter than one KV page, or ANY step of the dispatch
+            fails."""
+            import http.client as _hc
+
+            if not use_prefix or not affinity.shipping:
+                return None
+            try:
+                if fleet.role_counts(model_id).get("prefill", 0) < 1:
+                    return None
+                pre = fleet.select(route="generate", role="prefill",
+                                   model_id=model_id, tier=tier,
+                                   count=False)
+            except Exception:
+                return None  # no pool / shed: not a handoff failure
+            try:
+                hop_timeout, fwd_headers, eligible = \
+                    self._hop_budget(deadline, tier)
+                body = json.dumps(
+                    {"prompt": [r.prompt for r in rows]}).encode()
+                try:
+                    status, _, raw = pre.client.request(
+                        "POST", "/prefill", body,
+                        timeout=hop_timeout, headers=fwd_headers)
+                except (OSError, _hc.HTTPException) as e:
+                    fleet.note_request_failure(
+                        pre, e, breaker_eligible=eligible)
+                    raise
+                if status != 200:
+                    raise RuntimeError(f"/prefill answered {status}")
+                report = json.loads(raw)
+                fleet.note_request_success(pre)
+                if int(report.get("chunks") or 0) < 1:
+                    # prompts shorter than one full page: nothing was
+                    # parked, so a donor hint would buy nothing —
+                    # neither a handoff nor a failure
+                    return None
+                fleet._m_disagg_handoffs.inc()
+                fleet._m_disagg_handoff_bytes.inc(
+                    int(report.get("kv_bytes") or 0))
+                return pre.client.url
+            except Exception:
+                # ANY failure degrades to plain prefill on the decode
+                # replica — the stream is bit-identical either way
+                fleet._m_disagg_handoff_failures.inc()
+                fleet._m_disagg_fallbacks.inc()
+                return None
+            finally:
+                fleet.release(pre, tier)
 
         def _generate(self):
             data = self._read_json()  # parsed for stream/deadline
@@ -479,21 +575,23 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                 fleet._m_deadline["generate"].inc()
                 deadline.check("router dispatch")  # raises -> 504
             parsed = _parse_continuation(data)
+            model_id = self._model_id(data)
             start = time.perf_counter()
             try:
                 if parsed is None:
                     self._generate_passthrough(streaming, deadline,
-                                               tier, data)
+                                               tier, data, model_id)
                 else:
                     self._generate_durable(parsed, streaming, deadline,
-                                           tier)
+                                           tier, model_id)
             except _ClientGone:
                 self.close_connection = True
             finally:
                 fleet.observe("generate", time.perf_counter() - start,
                               tier=tier)
 
-        def _generate_durable(self, parsed, streaming, deadline, tier):
+        def _generate_durable(self, parsed, streaming, deadline, tier,
+                              model_id=None):
             """Failover-durable /generate: drive the replica in
             streaming mode (even for a non-streaming client), fold its
             NDJSON into the continuation record, and on replica failure
@@ -606,7 +704,15 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             # aligned), so one plan covers every hop: delivered tokens
             # extend the tail, never the head. Opted-out bodies skip
             # the hash entirely (use_prefix False -> None).
-            placement = self._kv_place(rows[0].prompt, use_prefix)
+            placement = self._kv_place(rows[0].prompt, use_prefix,
+                                       model_id)
+            # disaggregated handoff (prefill-role pool only): park the
+            # prompt KV on a prefill replica and name it as donor for
+            # the FIRST hop. Resume hops replay prompt + delivered on
+            # a survivor; the parked pages are stale for that longer
+            # context, so resumes use the affinity donor path instead.
+            handoff_donor = self._disagg_handoff(
+                rows, deadline, tier, model_id, use_prefix)
             affinity_noted = False
             attempt = 0
             last = (None, "no replica attempted")  # (id, detail)
@@ -642,7 +748,8 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                             route="generate",
                             exclude=tuple(failed),
                             tier=tier, prefer=prefer,
-                            prefer_slack=fleetkv.PLACEMENT_SLACK)
+                            prefer_slack=fleetkv.PLACEMENT_SLACK,
+                            model_id=model_id)
                     except (NoReadyReplicas, OverloadedError) as e:
                         reply_failed(last[0], f"{last[1]}; no surviving "
                                      f"replica to resume on ({e})")
@@ -654,7 +761,8 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                             count=not preempt_pending,
                             prefer=(placement.prefer
                                     if placement is not None else None),
-                            prefer_slack=fleetkv.PLACEMENT_SLACK)
+                            prefer_slack=fleetkv.PLACEMENT_SLACK,
+                            model_id=model_id)
                     except OverloadedError:
                         if not preempt_pending:
                             raise  # initial admission: shed the client
@@ -698,7 +806,13 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                 }
                 if eos_id is not None:
                     body["eos_id"] = eos_id
-                if (affinity.shipping and placement is not None
+                if (handoff_donor is not None and attempt == 0
+                        and not preempt_pending):
+                    # disaggregated handoff: the prefill replica just
+                    # parked this prompt's pages — it outranks any
+                    # affinity donor for the first hop
+                    body["kv_donor"] = handoff_donor
+                elif (affinity.shipping and placement is not None
                         and placement.depth > 0
                         and placement.donor_url
                         and replica.id != placement.donor
@@ -976,7 +1090,8 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
             self.wfile.write(data)
 
         def _generate_passthrough(self, streaming, deadline,
-                                  tier=TIER_INTERACTIVE, data=None):
+                                  tier=TIER_INTERACTIVE, data=None,
+                                  model_id=None):
             """The pre-failover path, kept for bodies that don't parse
             into a continuation record (string prompts, exotic fields,
             a client that is itself a resuming router): one replica,
@@ -990,12 +1105,13 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                                                   True)):
                 tokens = _head_row(data)
                 if tokens:
-                    placement = self._kv_place(tokens, True)
+                    placement = self._kv_place(tokens, True, model_id)
             replica = fleet.select(
                 route="generate", tier=tier,
                 prefer=(placement.prefer
                         if placement is not None else None),
-                prefer_slack=fleetkv.PLACEMENT_SLACK)
+                prefer_slack=fleetkv.PLACEMENT_SLACK,
+                model_id=model_id)
             if placement is not None:
                 fleet.note_affinity(placement.depth > 0 and
                                     replica.id == placement.prefer)
@@ -1101,11 +1217,13 @@ def serve_fleet(fleet, host: str = "127.0.0.1",
                 raise ValueError("reload needs {'path': <checkpoint>}")
             step = data.get("step")
             rb_step = data.get("rollback_step")
+            mid = data.get("model_id")
             result = fleet.rolling_reload(
                 str(path), step=None if step is None else int(step),
                 rollback_path=data.get("rollback_path"),
                 rollback_step=None if rb_step is None else int(rb_step),
-                probe=data.get("probe"))
+                probe=data.get("probe"),
+                model_id=None if mid is None else str(mid))
             self._reply(200 if result.get("reloaded") else 409, result)
 
         def _scale(self):

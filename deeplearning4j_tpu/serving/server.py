@@ -114,11 +114,19 @@ class ServingHandle:
     def __init__(self, replicas: ReplicaSet, batcher,
                  generate_engine: Optional[InferenceEngine],
                  http: Optional[ServerHandle] = None,
-                 warmup_pending: bool = False):
+                 warmup_pending: bool = False,
+                 role: str = "unified",
+                 model_id: Optional[str] = None):
         self.http = http
         self.replicas = replicas
         self.batcher = batcher
         self.generate_engine = generate_engine
+        # disaggregated-serving identity (docs/FLEET.md "Disaggregated
+        # roles"): announced in /readyz so the fleet's role/model
+        # registry reads placement identity off the probe that gates
+        # admission — never from config drift
+        self.role = role
+        self.model_id = model_id
         self.started_at = time.time()
         self.last_reload: Optional[dict] = None
         # readiness state: pre-set unless an async warmup is in flight
@@ -294,7 +302,11 @@ class ServingHandle:
                # journal and the deployment controller's convergence
                # check read WHAT this replica serves from the same
                # probe that gates admission (docs/PIPELINE.md)
-               "checkpoint": self.replicas.checkpoint}
+               "checkpoint": self.replicas.checkpoint,
+               # (role, model_id): the disaggregated fleet's placement
+               # identity (docs/FLEET.md "Disaggregated roles")
+               "role": self.role,
+               "model_id": self.model_id}
         if loop is not None:
             out["decode_loop_alive"] = loop.alive
             # fleet KV plane: the affinity summary rides the SAME
@@ -320,6 +332,8 @@ class ServingHandle:
 
         out = {"uptime_s": round(time.time() - self.started_at, 3),
                "checkpoint": self.replicas.checkpoint,
+               "role": self.role,
+               "model_id": self.model_id,
                "replicas": self.replicas.snapshot()}
         if self.batcher is not None:
             out["batcher"] = self.batcher.snapshot()
@@ -402,6 +416,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                   draft_params=None, draft_cfg=None,
                   draft_window: int = 32,
                   batch_share: float = 0.5,
+                  role: str = "unified",
+                  model_id: Optional[str] = None,
                   host: str = "127.0.0.1", port: int = 0,
                   warmup_shape=None,
                   warmup_async: bool = False,
@@ -447,7 +463,14 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
     default): batch-tier work rides the bulk lane — shed first at
     lower water marks, admitted behind interactive, preemptible —
     and `batch_share` tunes its weighted-fair slice of the decode
-    slots (docs/SERVING.md "Priority tiers").
+    slots (docs/SERVING.md "Priority tiers"). `role` declares this
+    replica's place in a disaggregated fleet (docs/FLEET.md
+    "Disaggregated roles"): "unified" (default) serves everything;
+    "prefill" computes prompt KV for handoff (`POST /prefill` +
+    /kv/export, /generate rejected); "decode" owns the streams. A
+    prefill role requires `prefix_cache=True` and `fleet_kv="on"`.
+    `model_id` names the served model for the router's multi-model
+    registry; both ride the /readyz payload.
 
     AOT warm-start (docs/WARMUP.md): `compile_cache=DIR` activates the
     persistent program cache for this process (pass engines built
@@ -495,7 +518,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                                           draft_params=draft_params,
                                           draft_cfg=draft_cfg,
                                           draft_window=draft_window,
-                                          batch_share=batch_share)
+                                          batch_share=batch_share,
+                                          role=role)
     batcher = replicas.batcher(max_batch_size=max_batch_size,
                                max_delay_ms=max_delay_ms,
                                max_queue=max_queue)
@@ -514,15 +538,20 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                  + list(replicas.engines)
                  if getattr(e, "cache_key", None) is not None), None)
             if cache_dir and identity:
+                # role-scoped plans (docs/WARMUP.md): a prefill
+                # replica's plan must never warm the decode ladder
+                # (and vice versa), so the role is part of the key
                 plan_path = _warmup_mod.auto_plan_path(cache_dir,
-                                                       identity)
+                                                       identity,
+                                                       role=role)
         else:
             plan_path = warmup_plan
         if plan_path:
             plan_doc = _warmup_mod.load_plan(plan_path)
     run_warmup = (warm is not None or plan_doc is not None)
     handle = ServingHandle(replicas, batcher, generate_engine,
-                           warmup_pending=(run_warmup and warmup_async))
+                           warmup_pending=(run_warmup and warmup_async),
+                           role=role, model_id=model_id)
     handle.warmup_plan = plan_doc
     handle.warmup_plan_path = plan_path
     if run_warmup and not warmup_async:
@@ -620,6 +649,8 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                     self._reload()
                 elif self.path.startswith("/kv/export"):
                     self._kv_export()
+                elif self.path.startswith("/prefill"):
+                    self._prefill()
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
             except chaos.ChaosReset:
@@ -742,6 +773,50 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
                 return
             self._reply_raw(200, "application/octet-stream", payload)
 
+        def _prefill(self):
+            """Prefill leg of a disaggregated handoff (docs/FLEET.md
+            "Disaggregated roles"): run the prompt's full-page prefill
+            on THIS replica and park the pages in its prefix trie —
+            the router then sets the decode replica's `kv_donor` hint
+            to this replica's URL so admission pulls the pages over
+            /kv/export. Any role can donate (a unified replica's trie
+            works the same way); a prefill-role replica serves ONLY
+            this and /kv/export. Per row: `chunks` full pages in the
+            prompt, `covered` already cached here, `cached` newly
+            adopted, `kv_bytes` the page payload the handoff makes
+            shippable."""
+            loop = (generate_engine.decode_loop
+                    if generate_engine is not None else None)
+            if loop is None:
+                self._reply(404, {"error": "no decode loop"})
+                return
+            data = self._read_json()
+            deadline = Deadline.from_request(self.headers, data)
+            raw = data.get("prompt", data.get("tokens"))
+            if not isinstance(raw, list) or not raw:
+                raise ValueError("prefill needs {'prompt': [token "
+                                 "ids]} (flat row or list of rows)")
+            if not isinstance(raw[0], list):
+                raw = [raw]
+            if deadline is not None:
+                deadline.check("prefill")  # 504 before compute
+            timeout = (None if deadline is None
+                       else max(0.05, deadline.remaining_s()))
+            reports = []
+            for row in raw:
+                tokens = [int(t) for t in row]
+                if not tokens:
+                    raise ValueError("prefill rows must be non-empty")
+                reports.append(loop.prefill_only(tokens,
+                                                 timeout=timeout))
+            self._reply(200, {
+                "chunks": sum(r["chunks"] for r in reports),
+                "covered": sum(r["covered"] for r in reports),
+                "cached": sum(r["cached"] for r in reports),
+                "kv_bytes": sum(r["kv_bytes"] for r in reports),
+                "rows": reports,
+            })
+
         def _generate(self):
             if generate_engine is None:
                 self._reply(404, {"error": "no generate engine configured"})
@@ -817,20 +892,30 @@ def serve_network(net=None, *, replicas: Optional[ReplicaSet] = None,
             # the same prefix_cache opt-out as the cache itself.
             donor = data.get("kv_donor")
             if donor and use_prefix:
-                ship_timeout = loop.kv_ship_timeout
-                if deadline is not None:
-                    ship_timeout = max(
-                        0.05, min(ship_timeout,
-                                  0.5 * deadline.remaining_s()))
-                shipped = set()
-                for row in prompt:
-                    head = tuple(
-                        row[:(row.size // loop.page_size)
-                            * loop.page_size].tolist())
-                    if head and head not in shipped:
-                        shipped.add(head)
-                        loop.kv_ship(str(donor), list(head),
-                                     timeout=ship_timeout)
+                try:
+                    # disagg handoff, install leg: a chaos fault here
+                    # models the wire tearing at the worst moment —
+                    # the pull is skipped and the request falls
+                    # through to plain prefill, bit-identically
+                    # (docs/FLEET.md "Disaggregated roles")
+                    chaos.hit("disagg.handoff", role="install",
+                              donor=str(donor))
+                    ship_timeout = loop.kv_ship_timeout
+                    if deadline is not None:
+                        ship_timeout = max(
+                            0.05, min(ship_timeout,
+                                      0.5 * deadline.remaining_s()))
+                    shipped = set()
+                    for row in prompt:
+                        head = tuple(
+                            row[:(row.size // loop.page_size)
+                                * loop.page_size].tolist())
+                        if head and head not in shipped:
+                            shipped.add(head)
+                            loop.kv_ship(str(donor), list(head),
+                                         timeout=ship_timeout)
+                except Exception:
+                    pass  # ANY handoff failure degrades to prefill
             # all-or-nothing admission: a malformed row 400s and an
             # admission shed 503s WITHOUT orphaning row-mates' streams
             # in running slots (submit_many validates every row, then

@@ -93,6 +93,14 @@ def pytest_configure(config):
         "affinity routing + peer-to-peer page shipping — docs/FLEET.md "
         "\"Fleet KV plane\"); the in-process drills run in tier-1 — "
         "run the whole layer with pytest -m fleetkv")
+    config.addinivalue_line(
+        "markers",
+        "disagg: disaggregated prefill/decode + multi-model routing "
+        "lane (replica roles, /prefill handoff, per-model fleet "
+        "registry — docs/FLEET.md \"Disaggregated roles\"); the "
+        "in-process drills run in tier-1, the SIGKILL-mid-handoff "
+        "process drill also carries @slow — run the whole layer with "
+        "pytest -m disagg")
 
 
 def pytest_collection_modifyitems(config, items):

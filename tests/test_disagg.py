@@ -1,0 +1,910 @@
+"""Disaggregated prefill/decode serving + multi-model fleet routing.
+
+The contracts under test (docs/FLEET.md "Disaggregated roles"):
+
+1. **Roles are validated and announced**: a `prefill` replica requires
+   the prefix cache + KV shipping (its trie IS the handoff buffer),
+   refuses to own streams, and carries its role through /readyz,
+   /stats, kv_summary and the warmup plan fragment.
+2. **prefill_only parks pages, never decodes**: the handoff source
+   computes full-page KV through the SAME bucketed prefill programs
+   admission uses, adopts the pages into the trie for /kv/export, and
+   never compiles a decode step — `decode_step_programs() == 0`.
+   A decode replica that pulls those pages prefills ONLY the tail and
+   streams bit-identically to the cold reference.
+3. **Role fences (regression)**: kv_donor hints and affinity placement
+   can never point stream traffic at a prefill-role replica —
+   `Fleet.select` (role=None), `Fleet.kv_summaries`, and
+   `RouterAffinity.plan` each filter independently.
+4. **Multi-model routing**: `X-Model` / `"model_id"` scope selection;
+   cross-model traffic never mixes; unknown models shed with 503;
+   rolling reload scoped by model touches only that model's replicas.
+5. **Handoff failure at ANY point degrades bit-identically**: chaos on
+   the export leg (/prefill 500s), chaos on the install leg (ship
+   skipped), or a dead prefill pool — the stream always completes with
+   the same bytes, `dl4j_disagg_*` counters tell the story, zero
+   client-visible failures. The SIGKILL-mid-storm process drill
+   carries @slow.
+6. **Role-scoped warmup plans**: `auto_plan_path` keys prefill/decode
+   plans apart (legacy digest preserved for unified) and the program
+   key-sets the two roles record are disjoint on the decode ladder.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.compilecache import warmup as warmup_mod
+from deeplearning4j_tpu.config import NeuralNetConfiguration
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   init_transformer_params)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import (Fleet, InferenceEngine, serve_fleet,
+                                        serve_network)
+from deeplearning4j_tpu.serving import fleetkv
+from deeplearning4j_tpu.serving.decode_loop import (ROLE_DECODE,
+                                                    ROLE_PREFILL,
+                                                    DecodeLoop)
+from deeplearning4j_tpu.serving.errors import OverloadedError
+from deeplearning4j_tpu.serving.fleet import NoReadyReplicas
+from deeplearning4j_tpu.serving.kv_cache import generate_cached
+from deeplearning4j_tpu.testing import chaos
+from deeplearning4j_tpu.testing.chaos import Rule
+from deeplearning4j_tpu.utils.httpd import start_http_server
+
+pytestmark = pytest.mark.disagg
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = TransformerConfig(vocab_size=17, d_model=32, n_heads=2, n_layers=2,
+                        d_ff=64, max_len=64, interpret=True)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    chaos.deactivate()
+
+
+def _params(seed=0):
+    return init_transformer_params(jax.random.PRNGKey(seed), CFG)
+
+
+def _prompt(rng, t):
+    return rng.randint(0, CFG.vocab_size, (t,)).astype(np.int32)
+
+
+def _ref_tokens(p, prompt, n):
+    return np.asarray(generate_cached(
+        p, jnp.asarray(np.asarray(prompt)[None]), CFG, n))[0].tolist()
+
+
+def _assert_balance(loop):
+    in_use = loop.pages_in_use
+    free = len(loop._free)
+    cached_unref = loop._cached_unref()
+    assert in_use + free + cached_unref == loop.n_pages, (
+        in_use, free, cached_unref, loop.n_pages)
+
+
+def _post(url, payload, timeout=120, headers=()):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(dict(headers))
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 headers=hdrs)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _net(n_in=4, n_out=3):
+    conf = (NeuralNetConfiguration.builder()
+            .lr(0.1).n_in(n_in).activation_function("tanh")
+            .optimization_algo("iteration_gradient_descent")
+            .num_iterations(1).use_adagrad(False)
+            .list(2).hidden_layer_sizes([8])
+            .override(1, layer="output", loss_function="mcxent",
+                      activation_function="softmax", n_out=n_out)
+            .pretrain(False).build())
+    return MultiLayerNetwork(conf)
+
+
+# ------------------------------------------------------ role validation
+class TestRoleValidation:
+    def test_unknown_role_rejected(self):
+        with pytest.raises(ValueError, match="role"):
+            DecodeLoop(_params(), CFG, slots=1, page_size=8,
+                       start=False, role="verifier")
+
+    def test_prefill_role_needs_cache_and_shipping(self):
+        p = _params()
+        with pytest.raises(ValueError, match="prefix"):
+            DecodeLoop(p, CFG, slots=1, page_size=8, start=False,
+                       role=ROLE_PREFILL, prefix_cache=False)
+        with pytest.raises(ValueError, match="fleet_kv"):
+            DecodeLoop(p, CFG, slots=1, page_size=8, start=False,
+                       role=ROLE_PREFILL, fleet_kv="affinity-only")
+
+    def test_prefill_role_refuses_streams_and_announces(self):
+        loop = DecodeLoop(_params(), CFG, slots=1, page_size=8,
+                          start=False, role=ROLE_PREFILL)
+        try:
+            with pytest.raises(ValueError, match="prefill"):
+                loop.submit([1, 2, 3, 4], 2)
+            assert loop.snapshot()["role"] == "prefill"
+            assert loop.kv_summary()["role"] == "prefill"
+            assert loop.plan_fragment()["role"] == "prefill"
+        finally:
+            loop.close()
+
+    def test_decode_role_still_streams(self):
+        p = _params()
+        rng = np.random.RandomState(0)
+        pr = _prompt(rng, 12)
+        loop = DecodeLoop(p, CFG, slots=1, page_size=8, start=False,
+                          role=ROLE_DECODE)
+        try:
+            st = loop.submit(pr, 3)
+            loop.run_until_idle()
+            assert st.full_sequence(5) == _ref_tokens(p, pr, 3)
+            assert loop.snapshot()["role"] == "decode"
+        finally:
+            loop.close()
+
+
+# -------------------------------------------------- loop-level handoff
+class TestPrefillHandoffLoop:
+    def test_handoff_bit_identical_tail_only_prefill(self):
+        """The headline path, loop-level: a prefill-role loop parks
+        the prompt's full pages; a decode loop ships them and prefills
+        ONLY the tail — bit-identical stream, both pools balanced, and
+        the prefill loop never compiled a decode step."""
+        p = _params()
+        rng = np.random.RandomState(1)
+        head = _prompt(rng, 16)                    # 2 full pages
+        full = np.concatenate([head, _prompt(rng, 4)])
+        ref = _ref_tokens(p, full, 6)
+        pre = DecodeLoop(p, CFG, slots=2, page_size=8, start=False,
+                         role=ROLE_PREFILL)
+        dec = DecodeLoop(p, CFG, slots=2, page_size=8, start=False,
+                         role=ROLE_DECODE)
+        try:
+            report = pre.prefill_only(list(full))
+            assert report["chunks"] == 2
+            assert report["covered"] == 0 and report["cached"] == 2
+            assert report["kv_bytes"] > 0
+            assert pre.snapshot()["fleet_kv"]["prefill_handoffs"] == 1
+
+            orig = fleetkv.fetch_pages
+            fleetkv.fetch_pages = (
+                lambda url, tokens, timeout, max_chunks=None:
+                pre.kv_export(list(tokens), max_chunks=max_chunks))
+            try:
+                assert dec.kv_ship("http://pre:1", list(full)) == 2
+            finally:
+                fleetkv.fetch_pages = orig
+            st = dec.submit(full, 6)
+            dec.run_until_idle()
+            assert st.full_sequence(5) == ref
+            snap = dec.snapshot()
+            assert snap["prefill_tokens"] == 4       # tail only, ever
+            assert snap["prefix_cache"]["hits"] == 1
+            # the handoff source never decoded anything
+            assert pre.decode_step_programs() == 0
+            assert pre.snapshot()["dispatches"] == 0
+            _assert_balance(pre)
+            _assert_balance(dec)
+        finally:
+            pre.close()
+            dec.close()
+
+    def test_repeat_handoff_is_a_cheap_covered_noop(self):
+        p = _params()
+        rng = np.random.RandomState(2)
+        full = _prompt(rng, 20)
+        pre = DecodeLoop(p, CFG, slots=2, page_size=8, start=False,
+                         role=ROLE_PREFILL)
+        try:
+            first = pre.prefill_only(list(full))
+            assert (first["chunks"], first["cached"]) == (2, 2)
+            again = pre.prefill_only(list(full))
+            assert again["covered"] == 2 and again["cached"] == 0
+            # sub-page prompts have nothing to hand off
+            tiny = pre.prefill_only([1, 2, 3])
+            assert tiny["chunks"] == 0 and tiny["kv_bytes"] == 0
+            _assert_balance(pre)
+        finally:
+            pre.close()
+
+    def test_pool_pressure_raises_overloaded_balanced(self):
+        p = _params()
+        rng = np.random.RandomState(3)
+        pre = DecodeLoop(p, CFG, slots=2, page_size=8, n_pages=2,
+                         start=False, role=ROLE_PREFILL)
+        try:
+            pre.prefill_only(list(_prompt(rng, 16)))  # fills the pool
+            # a prompt wider than the whole pool cannot be parked even
+            # after evicting the unreferenced cached pages
+            with pytest.raises(OverloadedError):
+                pre.prefill_only(list(_prompt(rng, 24)))
+            _assert_balance(pre)
+        finally:
+            pre.close()
+
+    @pytest.mark.chaos
+    def test_chaos_on_export_leg_raises_then_recovers(self):
+        p = _params()
+        rng = np.random.RandomState(4)
+        full = _prompt(rng, 16)
+        pre = DecodeLoop(p, CFG, slots=2, page_size=8, start=False,
+                         role=ROLE_PREFILL)
+        try:
+            chaos.configure([Rule("disagg.handoff", "error", at=[0])])
+            try:
+                with pytest.raises(chaos.ChaosError):
+                    pre.prefill_only(list(full))
+            finally:
+                chaos.deactivate()
+            _assert_balance(pre)
+            # the fault was transient: the very next handoff lands
+            report = pre.prefill_only(list(full))
+            assert report["cached"] == 2
+            _assert_balance(pre)
+        finally:
+            pre.close()
+
+
+# ------------------------------------------------- role/model fences
+def _fake_replica(record, role=None, model_id=None, summary=None,
+                  checkpoint=None):
+    """A fake replica speaking the serving surface the fleet registry
+    reads: /readyz announces (role, model_id, checkpoint, kv_summary),
+    /reload answers 200, /generate speaks a one-token NDJSON stream."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code, body):
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path.startswith("/healthz"):
+                self._send(200, b'{"ok": true}')
+            elif self.path.startswith("/readyz"):
+                payload = {"ready": True}
+                if role is not None:
+                    payload["role"] = role
+                if model_id is not None:
+                    payload["model_id"] = model_id
+                if checkpoint is not None:
+                    payload["checkpoint"] = checkpoint
+                if summary is not None:
+                    payload["kv_summary"] = summary
+                self._send(200, json.dumps(payload).encode())
+            else:
+                self._send(404, b"{}")
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            data = json.loads(self.rfile.read(length) or b"{}")
+            record.append({"path": self.path, "body": data})
+            if self.path.startswith("/reload"):
+                self._send(200, b'{"reloaded": true}')
+                return
+            if self.path.startswith("/prefill"):
+                self._send(200, json.dumps(
+                    {"chunks": 2, "covered": 0, "cached": 2,
+                     "kv_bytes": 4096, "rows": []}).encode())
+                return
+            lines = [{"row": i, "token": 1, "token_index": b}
+                     for i, b in enumerate(
+                         data.get("token_index_base",
+                                  [0] * len(data["prompt"])))]
+            lines.append({"done": True,
+                          "finish_reasons":
+                          ["max_tokens"] * len(data["prompt"])})
+            body = "".join(json.dumps(l) + "\n" for l in lines).encode()
+            self._send(200, body)
+
+    return start_http_server(Handler)
+
+
+def _ready_fleet(*servers, **fleet_kw):
+    fleet_kw.setdefault("heartbeat_timeout", 5.0)
+    fleet = Fleet(start=False, **fleet_kw)
+    reps = [fleet.attach(s.url) for s in servers]
+    for _ in range(200):
+        fleet.poll()
+        if fleet.ready_count() >= len(servers):
+            break
+        time.sleep(0.02)
+    assert fleet.ready_count() >= len(servers)
+    return fleet, reps
+
+
+class TestRoleFences:
+    def test_select_never_routes_streams_to_prefill(self):
+        """Regression (the satellite's headline): stream selection
+        with the default role must NEVER land on a prefill replica —
+        not even as an affinity `prefer` hint — while role="prefill"
+        reaches exactly the prefill pool."""
+        pre_reqs, dec_reqs = [], []
+        pre = _fake_replica(pre_reqs, role="prefill")
+        dec = _fake_replica(dec_reqs, role="decode")
+        fleet, (pre_rep, dec_rep) = _ready_fleet(pre, dec)
+        try:
+            for _ in range(6):
+                rep = fleet.select(route="generate")
+                assert rep.id == dec_rep.id
+                fleet.release(rep)
+            # the prefer hint passes through the same fence: naming
+            # the prefill replica cannot override it
+            rep = fleet.select(route="generate", prefer=pre_rep.id,
+                               prefer_slack=100)
+            assert rep.id == dec_rep.id
+            fleet.release(rep)
+            rep = fleet.select(route="generate", role="prefill")
+            assert rep.id == pre_rep.id
+            fleet.release(rep)
+            assert fleet.role_counts() == {"prefill": 1, "decode": 1}
+        finally:
+            fleet.close()
+            pre.close()
+            dec.close()
+
+    def test_prefill_only_fleet_has_no_stream_capacity(self):
+        reqs = []
+        pre = _fake_replica(reqs, role="prefill")
+        fleet, _ = _ready_fleet(pre)
+        try:
+            with pytest.raises(NoReadyReplicas):
+                fleet.select(route="generate")
+        finally:
+            fleet.close()
+            pre.close()
+
+    def test_kv_summaries_and_affinity_exclude_prefill(self):
+        """A prefill replica holding the DEEPEST summary match must
+        attract neither affinity placement nor a donor hint: both
+        `Fleet.kv_summaries` and `RouterAffinity.plan` filter it."""
+        toks = list(range(16))
+        heads = fleetkv.hash_chunks(toks, 8)
+        deep = {"v": 1, "mode": "on", "page_size": 8, "heads": heads,
+                "role": "prefill", "pages_cached": 2, "hits": 0,
+                "misses": 0, "page_ships": 0, "ship_bytes": 0,
+                "ship_failures": 0}
+        shallow = dict(deep, role="decode", heads=heads[:1])
+        pre = _fake_replica([], role="prefill", summary=deep)
+        dec = _fake_replica([], role="decode", summary=shallow)
+        fleet, (pre_rep, dec_rep) = _ready_fleet(pre, dec)
+        try:
+            summ = fleet.kv_summaries()
+            assert pre_rep.id not in summ and dec_rep.id in summ
+            # belt and braces: even a summary set that still carries
+            # the prefill entry is filtered inside plan()
+            aff = fleetkv.RouterAffinity("on")
+            raw = {pre_rep.id: (deep, pre.url),
+                   dec_rep.id: (shallow, dec.url)}
+            p = aff.plan(toks, raw)
+            assert p.prefer == dec_rep.id and p.depth == 1
+            assert aff.plan(toks, {pre_rep.id: (deep, pre.url)}) is None
+        finally:
+            fleet.close()
+            pre.close()
+            dec.close()
+
+    def test_kv_summaries_filter_by_model(self):
+        toks = list(range(16))
+        heads = fleetkv.hash_chunks(toks, 8)
+        summ = {"v": 1, "mode": "on", "page_size": 8, "heads": heads,
+                "pages_cached": 2, "hits": 0, "misses": 0,
+                "page_ships": 0, "ship_bytes": 0, "ship_failures": 0}
+        a = _fake_replica([], model_id="a", summary=summ)
+        b = _fake_replica([], model_id="b", summary=summ)
+        fleet, (a_rep, b_rep) = _ready_fleet(a, b)
+        try:
+            assert set(fleet.kv_summaries()) == {a_rep.id, b_rep.id}
+            assert set(fleet.kv_summaries(model_id="a")) == {a_rep.id}
+            assert set(fleet.kv_summaries(model_id="b")) == {b_rep.id}
+        finally:
+            fleet.close()
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------- multi-model fleet
+class TestMultiModelRouting:
+    def test_requests_route_by_model_and_never_mix(self):
+        """Body `model_id` and the `X-Model` header each scope routing;
+        an unknown model sheds with 503; zero cross-model hits."""
+        a_reqs, b_reqs = [], []
+        a = _fake_replica(a_reqs, model_id="a",
+                          checkpoint={"path": "/ck/a", "step": 1})
+        b = _fake_replica(b_reqs, model_id="b",
+                          checkpoint={"path": "/ck/b", "step": 2})
+        fleet, _ = _ready_fleet(a, b)
+        try:
+            with serve_fleet(fleet, fleet_kv="off") as router:
+                for _ in range(3):
+                    out = _post(f"{router.url}/generate",
+                                {"prompt": [[1, 2, 3]], "max_tokens": 1,
+                                 "model_id": "a"})
+                    assert out["finish_reasons"] == ["max_tokens"]
+                _post(f"{router.url}/generate",
+                      {"prompt": [[1, 2, 3]], "max_tokens": 1},
+                      headers={"X-Model": "b"})
+                gen_a = [r for r in a_reqs
+                         if r["path"].startswith("/generate")]
+                gen_b = [r for r in b_reqs
+                         if r["path"].startswith("/generate")]
+                assert len(gen_a) == 3 and len(gen_b) == 1
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _post(f"{router.url}/generate",
+                          {"prompt": [[1, 2]], "max_tokens": 1,
+                           "model_id": "zzz"})
+                assert ei.value.code == 503
+                assert json.loads(ei.value.read())["error"] == \
+                    "no_ready_replicas"
+                stats = _get(f"{router.url}/stats")["fleet"]
+                assert set(stats["models"]) == {"a", "b"}
+                assert stats["models"]["a"]["roles"] == {"unified": 1}
+                assert "/ck/a@1" in \
+                    stats["models"]["a"]["checkpoints_served"]
+                assert "/ck/b@2" in \
+                    stats["models"]["b"]["checkpoints_served"]
+        finally:
+            fleet.close()
+            a.close()
+            b.close()
+
+    def test_rolling_reload_scoped_by_model(self):
+        a_reqs, b_reqs = [], []
+        a = _fake_replica(a_reqs, model_id="a")
+        b = _fake_replica(b_reqs, model_id="b")
+        fleet, _ = _ready_fleet(a, b)
+        try:
+            res = fleet.rolling_reload("/ck/a2", step=7, model_id="a")
+            assert res["reloaded"] and res["model_id"] == "a"
+            assert [r for r in a_reqs
+                    if r["path"].startswith("/reload")]
+            assert not [r for r in b_reqs
+                        if r["path"].startswith("/reload")]
+            # the promoted identity pins per model, not fleet-wide
+            assert fleet.model_checkpoints["a"] == ("/ck/a2", 7)
+            assert fleet.current_checkpoint is None
+            snap = fleet.snapshot()
+            assert snap["models"]["a"]["current_checkpoint"] == "/ck/a2"
+            assert "current_checkpoint" not in snap["models"]["b"]
+            with pytest.raises(NoReadyReplicas):
+                fleet.rolling_reload("/ck/x", model_id="zzz")
+        finally:
+            fleet.close()
+            a.close()
+            b.close()
+
+    def test_predict_routes_by_model_header(self):
+        a_reqs, b_reqs = [], []
+        a = _fake_replica(a_reqs, model_id="a")
+        b = _fake_replica(b_reqs, model_id="b")
+
+        # the fakes above only speak /generate; /predict forwards raw
+        # bytes, so teach them by path prefix — the record already
+        # captures everything we need
+        fleet, _ = _ready_fleet(a, b)
+        try:
+            with serve_fleet(fleet, fleet_kv="off") as router:
+                try:
+                    _post(f"{router.url}/predict", {"rows": [[1]]},
+                          headers={"X-Model": "b"})
+                except urllib.error.HTTPError:
+                    pass  # the fake's NDJSON reply confuses nobody here
+                assert not [r for r in a_reqs
+                            if r["path"].startswith("/predict")]
+                assert [r for r in b_reqs
+                        if r["path"].startswith("/predict")]
+        finally:
+            fleet.close()
+            a.close()
+            b.close()
+
+
+# ----------------------------------------- router handoff (fake pools)
+class TestRouterHandoffDispatch:
+    def test_router_drives_prefill_then_names_donor(self):
+        """With a prefill pool present, the durable /generate first
+        POSTs /prefill on the prefill replica, then forwards the
+        stream to the decode replica with `kv_donor` naming the
+        prefill replica — and the disagg counters move."""
+        pre_reqs, dec_reqs = [], []
+        pre = _fake_replica(pre_reqs, role="prefill")
+        dec = _fake_replica(dec_reqs, role="decode")
+        fleet, _ = _ready_fleet(pre, dec)
+        try:
+            with serve_fleet(fleet, fleet_kv="on") as router:
+                out = _post(f"{router.url}/generate",
+                            {"prompt": [list(range(16))],
+                             "max_tokens": 1})
+                assert out["finish_reasons"] == ["max_tokens"]
+                assert [r for r in pre_reqs
+                        if r["path"].startswith("/prefill")]
+                gen = [r for r in dec_reqs
+                       if r["path"].startswith("/generate")]
+                assert len(gen) == 1
+                assert gen[0]["body"]["kv_donor"] == pre.url
+                # ... and the prefill replica NEVER saw the stream
+                assert not [r for r in pre_reqs
+                            if r["path"].startswith("/generate")]
+                disagg = _get(f"{router.url}/stats")["fleet"]["disagg"]
+                assert disagg["handoffs"] == 1
+                assert disagg["handoff_bytes"] == 4096
+                assert disagg["handoff_failures"] == 0
+                assert disagg["fallbacks"] == 0
+                # metrics scrape live off the router
+                with urllib.request.urlopen(f"{router.url}/metrics",
+                                            timeout=30) as r:
+                    text = r.read().decode()
+                for series in ("dl4j_disagg_handoffs",
+                               "dl4j_disagg_handoff_bytes",
+                               "dl4j_disagg_handoff_failures",
+                               "dl4j_disagg_fallbacks",
+                               "dl4j_fleet_role_replicas"):
+                    assert series in text, f"{series} missing"
+                lab = f'fleet="{fleet.label}"'
+                assert (f'dl4j_disagg_handoffs_total{{{lab}}} 1'
+                        in text)
+                assert ('dl4j_fleet_role_replicas{fleet="'
+                        f'{fleet.label}",model="default",'
+                        'role="prefill"} 1') in text
+        finally:
+            fleet.close()
+            pre.close()
+            dec.close()
+
+    def test_opted_out_and_short_prompts_skip_the_handoff(self):
+        pre_reqs, dec_reqs = [], []
+        pre = _fake_replica(pre_reqs, role="prefill")
+        dec = _fake_replica(dec_reqs, role="decode")
+        fleet, _ = _ready_fleet(pre, dec)
+        try:
+            with serve_fleet(fleet, fleet_kv="on") as router:
+                _post(f"{router.url}/generate",
+                      {"prompt": [list(range(16))], "max_tokens": 1,
+                       "prefix_cache": False})
+                assert pre_reqs == []  # opt-out: no prefill dispatch
+                gen = [r for r in dec_reqs
+                       if r["path"].startswith("/generate")]
+                assert "kv_donor" not in gen[0]["body"]
+        finally:
+            fleet.close()
+            pre.close()
+            dec.close()
+
+
+# --------------------------------------------------- HTTP e2e handoff
+class TestDisaggHTTP:
+    def _serve(self, p, role, **kw):
+        return serve_network(
+            _net(), n_replicas=1, max_delay_ms=1.0,
+            generate_engine=InferenceEngine.for_transformer(p, CFG),
+            slots=2, page_size=8, role=role, **kw)
+
+    def test_handoff_bit_identical_and_counters(self):
+        """Real processes-in-threads e2e: prefill + decode replicas
+        behind the router; a 2-page prompt hands off (router /prefill
+        -> kv_donor -> decode replica ships) and streams bit-identical
+        to the cold reference; the decode replica prefilled ONLY the
+        tail; disagg/role telemetry reads true."""
+        p = _params()
+        head = list(range(1, 17))
+        full = head + [3, 1, 4, 1]
+        ref = _ref_tokens(p, full, 4)
+        pre = self._serve(_params(), "prefill")
+        dec = self._serve(_params(), "decode")
+        fleet = Fleet(start=False, heartbeat_timeout=5.0)
+        router = None
+        try:
+            assert _get(f"{pre.url}/readyz")["role"] == "prefill"
+            fleet.attach(pre.url)
+            fleet.attach(dec.url)
+            for _ in range(200):
+                fleet.poll()
+                if fleet.ready_count() >= 2:
+                    break
+                time.sleep(0.02)
+            assert fleet.role_counts() == {"prefill": 1, "decode": 1}
+            router = serve_fleet(fleet, fleet_kv="on")
+            out = _post(f"{router.url}/generate",
+                        {"prompt": [full], "max_tokens": 4})
+            assert out["tokens"][0] == full + ref[len(full):] \
+                or out["tokens"][0] == ref  # full_sequence shape
+            assert out["finish_reasons"] == ["max_tokens"]
+            disagg = _get(f"{router.url}/stats")["fleet"]["disagg"]
+            assert disagg["handoffs"] == 1
+            assert disagg["handoff_bytes"] > 0
+            assert disagg["handoff_failures"] == 0
+            pre_dec = _get(f"{pre.url}/stats")["generate"]["decode"]
+            assert pre_dec["fleet_kv"]["prefill_handoffs"] == 1
+            assert pre_dec["decode_step_programs"] == 0
+            assert _get(f"{pre.url}/stats")["role"] == "prefill"
+            dec_dec = _get(f"{dec.url}/stats")["generate"]["decode"]
+            assert dec_dec["fleet_kv"]["page_ships"] == 2
+            assert dec_dec["fleet_kv"]["ship_failures"] == 0
+            assert dec_dec["prefill_tokens"] == 4  # tail only, ever
+            assert dec_dec["prefix_cache"]["hits"] == 1
+        finally:
+            if router is not None:
+                router.close()
+            fleet.close()
+            pre.close()
+            dec.close()
+
+    @pytest.mark.chaos
+    def test_chaos_at_every_handoff_point_degrades_bit_identical(self):
+        """Handoff failure at ANY point degrades to plain unified
+        prefill with the SAME bytes: chaos on the export leg (the
+        /prefill 500s -> failed handoff + fallback counters), chaos on
+        the install leg (donor hint dropped on the decode replica),
+        and a dead prefill pool (no dispatch at all). Zero
+        client-visible failures throughout."""
+        p = _params()
+        rng = np.random.RandomState(8)
+        pre = self._serve(_params(), "prefill")
+        dec = self._serve(_params(), "decode")
+        fleet = Fleet(start=False, heartbeat_timeout=0.8,
+                      heartbeat_interval=0.1)
+        router = None
+        try:
+            fleet.attach(pre.url)
+            fleet.attach(dec.url)
+            for _ in range(200):
+                fleet.poll()
+                if fleet.ready_count() >= 2:
+                    break
+                time.sleep(0.02)
+            router = serve_fleet(fleet, fleet_kv="on")
+
+            def run(prompt, n=4):
+                out = _post(f"{router.url}/generate",
+                            {"prompt": [prompt], "max_tokens": n})
+                assert out["finish_reasons"] == ["max_tokens"]
+                return out["tokens"][0]
+
+            # export leg: the very first disagg.handoff hit is the
+            # prefill replica's export — /prefill answers 500, the
+            # router counts a failed handoff and falls back
+            p1 = [int(t) for t in _prompt(rng, 20)]
+            chaos.configure([Rule("disagg.handoff", "error", at=[0])])
+            try:
+                toks = run(p1)
+            finally:
+                chaos.deactivate()
+            assert toks[len(p1):] == _ref_tokens(p, p1, 4)[len(p1):]
+            disagg = _get(f"{router.url}/stats")["fleet"]["disagg"]
+            assert disagg["handoff_failures"] == 1
+            assert disagg["fallbacks"] == 1
+            assert disagg["handoffs"] == 0
+
+            # install leg: hit 0 is the export (succeeds is wrong —
+            # ordinal 0 already burned above; reconfigure fresh), hit 1
+            # is the decode replica's install — the ship is skipped
+            # and the decode replica prefills the WHOLE prompt
+            p2 = [int(t) for t in _prompt(rng, 20)]
+            before = _get(f"{dec.url}/stats")["generate"]["decode"]
+            chaos.configure([Rule("disagg.handoff", "error", at=[1])])
+            try:
+                toks = run(p2)
+            finally:
+                chaos.deactivate()
+            assert toks[len(p2):] == _ref_tokens(p, p2, 4)[len(p2):]
+            after = _get(f"{dec.url}/stats")["generate"]["decode"]
+            assert after["fleet_kv"]["page_ships"] == \
+                before["fleet_kv"]["page_ships"]  # install skipped
+            assert after["prefill_tokens"] - before["prefill_tokens"] \
+                == len(p2)  # plain prefill, full prompt
+            disagg = _get(f"{router.url}/stats")["fleet"]["disagg"]
+            assert disagg["handoffs"] == 1  # dispatch itself landed
+
+            # dead prefill pool: evict it, no dispatch is attempted
+            pre.close()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                fleet.poll()
+                if fleet.role_counts().get("prefill", 0) == 0:
+                    break
+                time.sleep(0.05)
+            assert fleet.role_counts().get("prefill", 0) == 0
+            p3 = [int(t) for t in _prompt(rng, 20)]
+            toks = run(p3)
+            assert toks[len(p3):] == _ref_tokens(p, p3, 4)[len(p3):]
+            disagg2 = _get(f"{router.url}/stats")["fleet"]["disagg"]
+            assert disagg2["handoffs"] == disagg["handoffs"]
+            assert disagg2["handoff_failures"] == \
+                disagg["handoff_failures"]
+            # page invariant on both survivors of all that
+            dec_dec = _get(f"{dec.url}/stats")["generate"]["decode"]
+            assert dec_dec["pages_in_use"] == 0
+        finally:
+            if router is not None:
+                router.close()
+            fleet.close()
+            pre.close()
+            dec.close()
+
+
+# ------------------------------------------------- role-scoped warmup
+@pytest.mark.aot
+class TestRoleScopedWarmup:
+    def test_auto_plan_path_keys_roles_apart(self, tmp_path):
+        root = str(tmp_path)
+        legacy = warmup_mod.auto_plan_path(root, "ck")
+        assert warmup_mod.auto_plan_path(root, "ck", role=None) == legacy
+        assert warmup_mod.auto_plan_path(root, "ck",
+                                         role="unified") == legacy
+        pre = warmup_mod.auto_plan_path(root, "ck", role="prefill")
+        dec = warmup_mod.auto_plan_path(root, "ck", role="decode")
+        assert len({legacy, pre, dec}) == 3
+        assert os.path.dirname(pre) == os.path.dirname(legacy)
+
+    def test_role_program_key_sets_are_disjoint_on_the_ladder(self):
+        """A prefill-role loop's recorded plan covers only the prefill
+        lanes; a decode-driven loop's covers the step ladder — so
+        neither role's warmup ever compiles the other's programs and
+        `recompiled_after_warmup == 0` holds per role."""
+        p = _params()
+        rng = np.random.RandomState(9)
+        full = _prompt(rng, 20)
+        pre = DecodeLoop(p, CFG, slots=2, page_size=8, start=False,
+                         role=ROLE_PREFILL)
+        dec = DecodeLoop(p, CFG, slots=2, page_size=8, start=False,
+                         role=ROLE_DECODE)
+        try:
+            pre.prefill_only(list(full))
+            st = dec.submit(full, 3)
+            dec.run_until_idle()
+            assert st.done
+            pf = pre.plan_fragment()
+            df = dec.plan_fragment()
+            assert pf["role"] == "prefill" and df["role"] == "decode"
+            assert pf["step"] is False and pf["verify"] is False
+            assert df["step"] is True
+            assert pf["prefill"]  # the handoff recorded its buckets
+            assert pre.decode_step_programs() == 0
+            assert dec.decode_step_programs() == 1
+        finally:
+            pre.close()
+            dec.close()
+
+
+# ================== real processes: SIGKILL-mid-handoff storm (@slow)
+def _role_spawner(tmp_path, role, slow_ms=40):
+    from deeplearning4j_tpu.scaleout.checkpoint import DefaultModelSaver
+    from deeplearning4j_tpu.serving.fleet import ReplicaSpawner
+
+    ckpt = str(tmp_path / "disagg.ckpt")
+    if not os.path.exists(ckpt):
+        DefaultModelSaver(ckpt, keep_old=False).save(_net())
+    spec = str(tmp_path / "tf.json")
+    if not os.path.exists(spec):
+        with open(spec, "w") as f:
+            json.dump({"vocab_size": 17, "d_model": 32, "n_heads": 2,
+                       "n_layers": 2, "d_ff": 64, "max_len": 64,
+                       "interpret": True, "seed": 0}, f)
+    env = dict(os.environ,
+               PYTHONPATH=REPO_ROOT + os.pathsep
+               + os.environ.get("PYTHONPATH", ""),
+               JAX_PLATFORMS="cpu",
+               **chaos.env_spec([Rule("generate.midstream", "delay",
+                                      delay_s=slow_ms / 1000.0)]))
+    return ReplicaSpawner(ckpt,
+                          serve_args=["--max-delay-ms", "1",
+                                      "--transformer", spec,
+                                      "--slots", "4",
+                                      "--page-size", "8",
+                                      "--role", role],
+                          env=env)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestDisaggProcessDrill:
+    PROMPT = list(range(1, 17)) + [3, 1, 4, 1]   # 2 full pages + tail
+    N_TOKENS = 24
+
+    def test_sigkill_prefill_mid_storm_zero_client_failures(
+            self, tmp_path):
+        """ISSUE acceptance drill: a long-prompt storm over a
+        prefill=1/decode=2 fleet of REAL processes; the prefill
+        replica is SIGKILLed while handoffs are in flight. Every
+        stream completes bit-identically to the uninterrupted
+        reference (handoffs that died fall back to plain prefill),
+        zero client-visible failures, and at least one handoff
+        actually happened before the kill."""
+        fleet = Fleet(heartbeat_interval=0.2, heartbeat_timeout=3.0,
+                      breaker_threshold=2, breaker_reset_s=0.4)
+        router = None
+        try:
+            fleet.add_pool(role="prefill",
+                           spawner=_role_spawner(tmp_path, "prefill"))
+            fleet.add_pool(role="decode",
+                           spawner=_role_spawner(tmp_path, "decode"))
+            pre_rep = fleet.spawn_pool("default", "prefill", 1)[0]
+            fleet.spawn_pool("default", "decode", 2)
+            fleet.wait_ready(3, timeout=300)
+            assert fleet.role_counts() == {"prefill": 1, "decode": 2}
+            router = serve_fleet(fleet, fleet_kv="on")
+            ref = _post(f"{router.url}/generate",
+                        {"prompt": [self.PROMPT],
+                         "max_tokens": self.N_TOKENS}, timeout=300)
+            ref_toks = ref["tokens"][0]
+            handoffs0 = _get(
+                f"{router.url}/stats")["fleet"]["disagg"]["handoffs"]
+            assert handoffs0 >= 1
+
+            n = 4
+            results, failures = [None] * n, []
+
+            def worker(i):
+                try:
+                    results[i] = _post(
+                        f"{router.url}/generate",
+                        {"prompt": [self.PROMPT],
+                         "max_tokens": self.N_TOKENS}, timeout=300)
+                except Exception as e:  # noqa: BLE001
+                    failures.append(repr(e))
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        daemon=True) for i in range(n)]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)          # let handoffs get in flight
+            chaos.sigkill(pre_rep.proc)
+            for t in threads:
+                t.join(timeout=300)
+            assert failures == []    # ZERO client-visible failures
+            for out in results:
+                assert out is not None
+                assert out["tokens"][0] == ref_toks
+                assert out["finish_reasons"] == ["max_tokens"]
+            # the decode pool survived with its pages balanced (the
+            # dead prefill replica may still await heartbeat timeout —
+            # only the decode survivors answer /stats)
+            deadline = time.monotonic() + 10.0
+            survivors = [rep for rep in fleet.ready_replicas()
+                         if rep.id != pre_rep.id
+                         and (rep.role or "unified") != "prefill"]
+            assert len(survivors) == 2
+            for rep in survivors:
+                while time.monotonic() < deadline:
+                    dec = rep.client.stats()["generate"]["decode"]
+                    if dec["pages_in_use"] == 0:
+                        break
+                    time.sleep(0.1)
+                assert dec["pages_in_use"] == 0
+                assert dec["decode_step_programs"] <= 1
+        finally:
+            if router is not None:
+                router.close(stop_replicas=True)
+            else:
+                fleet.close(stop_replicas=True)
